@@ -1,0 +1,195 @@
+"""Configuration: one dataclass + CLI covering the reference's whole flag
+matrix.
+
+The reference duplicates its argparse block per variant directory
+(``Balanced All-Reduce/main.py:83-96``; ``Disbalanced All-Reduce/main.py:101``
+adds ``--fixed_ratio``); the 2x3 variant matrix itself is "configured" by
+directory choice.  Here topology (allreduce | ring | double_ring) and data
+mode (balanced | disbalanced) are flags, collapsing six directories into one
+framework.  Every reference flag name and default is preserved for parity.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass
+class Config:
+    """Full run configuration.
+
+    Parity flags (names + defaults match the reference CLI,
+    ``Balanced All-Reduce/main.py:83-96``):
+    """
+
+    # --- reference-parity flags -------------------------------------------
+    backend: str = "jax"          # ref: gloo|nccl (torch.dist) / implicit MPI.
+    #                               Accepted values gloo|nccl|mpi are compat
+    #                               no-ops: the backend is always XLA.
+    epochs_local: int = 5
+    epochs_global: int = 20
+    batch_size: int = 64
+    lr: float = 1e-3
+    time_limit: float = 60.0      # straggler grace budget, seconds
+    prev_fraction: float = 0.5    # re-partition: fraction from own prev shard
+    next_fraction: float = 0.5    # re-partition: fraction from global pool
+    aggregation_type: str = "equal"      # equal | weighted
+    aggregation_by: str = "gradients"    # gradients | weights (ref default)
+    local_weight: float = 0.5     # own-value weight in 'weighted' aggregation
+    fixed_ratio: float = 0.5      # disbalanced: share of shard pinned to the
+    #                               worker's two fixed classes
+    #                               (Disbalanced All-Reduce/main.py:101)
+
+    # --- variant selectors (directories in the reference, flags here) ------
+    topology: str = "allreduce"   # allreduce | ring | double_ring
+    data_mode: str = "balanced"   # balanced | disbalanced
+
+    # --- framework-level knobs (new, TPU-first) ----------------------------
+    model: str = "enhanced_cnn"   # enhanced_cnn | mlp | lenet5 | resnet18 |
+    #                               resnet50 | bert_base
+    dataset: str = "cifar10"      # cifar10 | mnist | imagenet | synthetic_mlm
+    num_workers: int = 0          # 0 => use all devices on the mesh data axis
+    seed: int = 0
+    dtype: str = "float32"        # param dtype
+    compute_dtype: str = "bfloat16"  # activation/matmul dtype on TPU
+    optimizer: str = "adam"       # ref: Adam (main.py:53)
+    lr_step_size: int = 25        # StepLR(step_size=25) per LOCAL epoch
+    lr_gamma: float = 0.1         # torch StepLR default gamma
+    # Heterogeneity-proportional shard sizing.  The reference gives SLOWER
+    # workers MORE data (shard size ~ measured duration,
+    # Balanced All-Reduce/dataloader.py:149-151 — defect SURVEY.md 2.5.1).
+    # 'inverse' is the sensible default; 'direct' reproduces the reference.
+    proportionality: str = "inverse"   # inverse | direct | uniform
+    probe_batches: int = 10       # timing-probe batches (dataloader.py:39)
+    data_dir: str = "data"        # real CIFAR-10 binaries if present
+    out_dir: str = "Graphs"       # plot output dir (ref: Graphs/*.png)
+    checkpoint_dir: str = ""      # empty => checkpointing off
+    checkpoint_every: int = 0     # global epochs between checkpoints
+    resume: bool = False
+    profile_dir: str = ""         # empty => no jax.profiler traces
+    log_level: str = "info"
+    limit_train_samples: int = 0  # 0 => full dataset (tests use small values)
+    limit_eval_samples: int = 0
+    augment: bool = True          # AutoAugment-equivalent on-device policy
+
+    # --- multi-axis mesh (beyond-reference parallelism) --------------------
+    mesh_shape: str = "data=-1"   # e.g. "data=8", "data=4,model=2",
+    #                               "data=2,model=2,pipe=2"
+    sequence_parallel: str = "none"  # none | ring | all_to_all (for bert)
+
+    def __post_init__(self) -> None:
+        _choices("backend", self.backend, ("jax", "gloo", "nccl", "mpi"))
+        _choices("aggregation_type", self.aggregation_type, ("equal", "weighted"))
+        _choices("aggregation_by", self.aggregation_by, ("gradients", "weights"))
+        _choices("topology", self.topology, ("allreduce", "ring", "double_ring"))
+        _choices("data_mode", self.data_mode, ("balanced", "disbalanced"))
+        _choices("proportionality", self.proportionality, ("inverse", "direct", "uniform"))
+        if not 0.0 <= self.local_weight <= 1.0:
+            raise ValueError(f"local_weight must be in [0,1], got {self.local_weight}")
+        if not 0.0 <= self.fixed_ratio <= 1.0:
+            raise ValueError(f"fixed_ratio must be in [0,1], got {self.fixed_ratio}")
+
+    # Convenience ----------------------------------------------------------
+    def replace(self, **kw: Any) -> "Config":
+        return dataclasses.replace(self, **kw)
+
+    def mesh_axes(self) -> dict[str, int]:
+        """Parse ``mesh_shape`` into an ordered {axis: size} dict.
+
+        A size of -1 means "all remaining devices" (resolved in mesh.py).
+        """
+        axes: dict[str, int] = {}
+        for part in self.mesh_shape.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, size = part.partition("=")
+            axes[name.strip()] = int(size) if size else -1
+        if "data" not in axes:
+            axes = {"data": -1, **axes}
+        return axes
+
+
+def _choices(name: str, value: str, allowed: tuple[str, ...]) -> None:
+    if value not in allowed:
+        raise ValueError(f"{name} must be one of {allowed}, got {value!r}")
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    """CLI with every reference flag (same names, same defaults) plus the
+    framework selectors.  Reference flags: Balanced All-Reduce/main.py:83-96,
+    Disbalanced All-Reduce/main.py:94,101."""
+    d = Config()
+    p = argparse.ArgumentParser(
+        description="TPU-native local-SGD distributed training framework")
+    # Reference-parity flags (incl. the reference's dead flags, accepted as
+    # documented no-ops so existing launch scripts keep working).
+    p.add_argument("--local-rank", type=int, dest="local_rank", default=None,
+                   help="[compat no-op] torch.distributed.launch artifact")
+    p.add_argument("--backend", type=str, default=d.backend,
+                   choices=["jax", "gloo", "nccl", "mpi"],
+                   help="[compat] backend is always XLA; gloo/nccl/mpi accepted as no-ops")
+    p.add_argument("--epochs_local", type=int, default=d.epochs_local)
+    p.add_argument("--epochs_global", type=int, default=d.epochs_global)
+    p.add_argument("--batch_size", type=int, default=d.batch_size)
+    p.add_argument("--lr", type=float, default=d.lr)
+    p.add_argument("--time_limit", type=float, default=d.time_limit,
+                   help="straggler grace budget in seconds")
+    p.add_argument("--prev_fraction", type=float, default=d.prev_fraction)
+    p.add_argument("--next_fraction", type=float, default=d.next_fraction)
+    p.add_argument("--aggregation_type", type=str, default=d.aggregation_type,
+                   choices=["equal", "weighted"])
+    p.add_argument("--aggregation_by", type=str, default=d.aggregation_by,
+                   choices=["gradients", "weights"])
+    p.add_argument("--local_weight", type=float, default=d.local_weight)
+    p.add_argument("--fixed_ratio", type=float, default=d.fixed_ratio)
+    p.add_argument("--gpu_weight", type=float, default=None,
+                   help="[compat no-op] dead reference flag "
+                        "(Disbalanced All-Reduce/main.py:94)")
+    p.add_argument("--dist-url", type=str, dest="dist_url", default=None,
+                   help="[compat no-op] dead reference flag "
+                        "(Balanced Double-Ring/main.py:80)")
+    # Variant selectors
+    p.add_argument("--topology", type=str, default=d.topology,
+                   choices=["allreduce", "ring", "double_ring"])
+    p.add_argument("--data_mode", type=str, default=d.data_mode,
+                   choices=["balanced", "disbalanced"])
+    # Framework knobs
+    p.add_argument("--model", type=str, default=d.model)
+    p.add_argument("--dataset", type=str, default=d.dataset)
+    p.add_argument("--num_workers", type=int, default=d.num_workers)
+    p.add_argument("--seed", type=int, default=d.seed)
+    p.add_argument("--device", type=str, default=None,
+                   help="tpu|cpu — force a JAX platform (default: auto)")
+    p.add_argument("--dtype", type=str, default=d.dtype)
+    p.add_argument("--compute_dtype", type=str, default=d.compute_dtype)
+    p.add_argument("--proportionality", type=str, default=d.proportionality,
+                   choices=["inverse", "direct", "uniform"])
+    p.add_argument("--probe_batches", type=int, default=d.probe_batches)
+    p.add_argument("--data_dir", type=str, default=d.data_dir)
+    p.add_argument("--out_dir", type=str, default=d.out_dir)
+    p.add_argument("--checkpoint_dir", type=str, default=d.checkpoint_dir)
+    p.add_argument("--checkpoint_every", type=int, default=d.checkpoint_every)
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--profile_dir", type=str, default=d.profile_dir)
+    p.add_argument("--limit_train_samples", type=int, default=d.limit_train_samples)
+    p.add_argument("--limit_eval_samples", type=int, default=d.limit_eval_samples)
+    p.add_argument("--no_augment", action="store_true")
+    p.add_argument("--mesh_shape", type=str, default=d.mesh_shape)
+    p.add_argument("--sequence_parallel", type=str, default=d.sequence_parallel,
+                   choices=["none", "ring", "all_to_all"])
+    return p
+
+
+def config_from_args(argv: list[str] | None = None) -> Config:
+    args = build_argparser().parse_args(argv)
+    import os
+    if args.device:
+        # explicit CLI choice overrides any inherited JAX_PLATFORMS
+        os.environ["JAX_PLATFORMS"] = args.device
+    field_names = {f.name for f in dataclasses.fields(Config)}
+    kw = {k: v for k, v in vars(args).items() if k in field_names}
+    kw["augment"] = not args.no_augment
+    return Config(**kw)

@@ -1,0 +1,17 @@
+"""Data subsystem: sources (real-or-synthetic datasets), the
+heterogeneity-adaptive partitioner, non-IID injection, and on-device
+augmentation."""
+
+from .sources import Dataset, load_dataset, train_val_split  # noqa: F401
+from .partition import (  # noqa: F401
+    budget_from_time_limit,
+    contiguous_partition,
+    efficiency_ratios,
+    fixed_classes_for_rank,
+    pack_shard,
+    repartition,
+    skew_partition,
+    skew_repartition,
+    step_budget,
+)
+from .augment import augment_batch  # noqa: F401

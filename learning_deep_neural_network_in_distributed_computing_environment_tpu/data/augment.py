@@ -1,0 +1,58 @@
+"""On-device data augmentation (AutoAugment-equivalent capability).
+
+The reference applies torchvision ``AutoAugment(CIFAR10)`` on the host per
+sample (``Balanced All-Reduce/dataloader.py:14-20``).  A TPU-first pipeline
+keeps the raw batch in HBM and applies a stochastic augmentation policy
+*inside the jitted train step* — fused by XLA, zero host round-trips.
+
+The policy here covers the same capability class (geometric + photometric +
+occlusion): random horizontal flip, pad-4-reflect random crop, random
+brightness/contrast, and cutout.  It is not a bit-exact AutoAugment
+reproduction (torchvision's learned sub-policy table is host-side PIL); the
+training-signal role — label-preserving stochastic regularization — is the
+parity target.  Toggled by ``Config.augment``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def augment_batch(rng: jax.Array, x: jnp.ndarray, *, pad: int = 4,
+                  cutout_size: int = 8) -> jnp.ndarray:
+    """Augment a batch [B, H, W, C] (normalized images).
+
+    All ops are batched + vectorized: one gather per image for the crop, a
+    where-mask for flip and cutout — no dynamic shapes, jit-friendly.
+    """
+    b, h, w, c = x.shape
+    k_flip, k_crop_y, k_crop_x, k_bright, k_contrast, k_cut_y, k_cut_x = \
+        jax.random.split(rng, 7)
+
+    # random horizontal flip (p=0.5) per image
+    flip = jax.random.bernoulli(k_flip, 0.5, (b, 1, 1, 1))
+    x = jnp.where(flip, x[:, :, ::-1, :], x)
+
+    # pad-and-crop: reflect-pad then per-image offset gather
+    xp = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="reflect")
+    oy = jax.random.randint(k_crop_y, (b,), 0, 2 * pad + 1)
+    ox = jax.random.randint(k_crop_x, (b,), 0, 2 * pad + 1)
+    rows = oy[:, None] + jnp.arange(h)[None, :]          # [B, H]
+    cols = ox[:, None] + jnp.arange(w)[None, :]          # [B, W]
+    x = xp[jnp.arange(b)[:, None, None], rows[:, :, None], cols[:, None, :], :]
+
+    # photometric jitter (on normalized data: gain around 1, bias around 0)
+    gain = jax.random.uniform(k_contrast, (b, 1, 1, 1), minval=0.8, maxval=1.2)
+    bias = jax.random.uniform(k_bright, (b, 1, 1, 1), minval=-0.2, maxval=0.2)
+    x = x * gain + bias
+
+    # cutout: zero a random square per image
+    cy = jax.random.randint(k_cut_y, (b, 1, 1), 0, h)
+    cx = jax.random.randint(k_cut_x, (b, 1, 1), 0, w)
+    yy = jnp.arange(h)[None, :, None]
+    xx = jnp.arange(w)[None, None, :]
+    inside = ((jnp.abs(yy - cy) <= cutout_size // 2) &
+              (jnp.abs(xx - cx) <= cutout_size // 2))
+    x = jnp.where(inside[..., None], 0.0, x)
+    return x

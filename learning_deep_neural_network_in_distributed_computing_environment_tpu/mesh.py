@@ -1,0 +1,97 @@
+"""Device-mesh construction and multi-host initialization.
+
+Replaces the reference's dual communication backend setup — torch.distributed
+``init_process_group`` with a hardcoded localhost rendezvous
+(``Balanced All-Reduce/main.py:14-19``) and ``MPI.COMM_WORLD``
+(``Balanced Ring/main.py:15-17``) — with a single XLA path:
+``jax.distributed.initialize()`` for multi-host rendezvous and a
+``jax.sharding.Mesh`` whose named axes carry all collectives over ICI/DCN.
+
+The data-parallel "worker" of the reference maps to one position on the
+``data`` mesh axis.  Extra axes (``model``, ``pipe``, ``seq``) host the
+beyond-reference parallelism (TP/PP/SP).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import os
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+log = logging.getLogger(__name__)
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+PIPE_AXIS = "pipe"
+SEQ_AXIS = "seq"
+
+
+def initialize_distributed() -> None:
+    """Multi-host rendezvous (no-op on a single process).
+
+    TPU pods populate the coordinator env automatically; on CPU/GPU fleets the
+    standard JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID env
+    vars are honored.  This replaces MASTER_ADDR/MASTER_PORT + gloo/nccl/MPI
+    (reference main.py:14-19).
+    """
+    if os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        try:
+            jax.distributed.initialize()
+        except RuntimeError:
+            pass  # already initialized (e.g. by the TPU runtime)
+
+
+def resolve_axes(axes: dict[str, int], n_devices: int | None = None) -> dict[str, int]:
+    """Resolve -1 entries in an {axis: size} dict against the device count.
+
+    At most one axis may be -1.  Sizes must multiply to <= n_devices and
+    divide it exactly when -1 is used.
+    """
+    n = n_devices if n_devices is not None else len(jax.devices())
+    fixed = math.prod(s for s in axes.values() if s > 0)
+    wild = [a for a, s in axes.items() if s <= 0]
+    if len(wild) > 1:
+        raise ValueError(f"at most one mesh axis may be -1, got {axes}")
+    out = dict(axes)
+    if wild:
+        if n % fixed:
+            raise ValueError(f"{n} devices not divisible by fixed axes {axes}")
+        out[wild[0]] = n // fixed
+    total = math.prod(out.values())
+    if total > n:
+        raise ValueError(f"mesh {out} needs {total} devices, only {n} available")
+    return out
+
+
+def build_mesh(axes: dict[str, int] | None = None,
+               devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """Build a Mesh with named axes.  Default: 1-D ``data`` mesh over all
+    devices (the reference's world of N data-parallel workers).
+
+    Device order follows ``jax.devices()``, which on TPU slices enumerates in
+    torus-contiguous order, so a 1-D ``data`` axis rides the ICI ring — the
+    property the ring/double-ring gossip topologies (ppermute) rely on.
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    axes = resolve_axes(axes or {DATA_AXIS: -1}, len(devs))
+    total = math.prod(axes.values())
+    grid = np.array(devs[:total]).reshape(tuple(axes.values()))
+    return Mesh(grid, tuple(axes.keys()))
+
+
+def data_sharding(mesh: Mesh, *, extra_dims: int = 0) -> NamedSharding:
+    """Sharding for a [global_batch, ...] array split over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * extra_dims)))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def world_size(mesh: Mesh) -> int:
+    return mesh.shape[DATA_AXIS]

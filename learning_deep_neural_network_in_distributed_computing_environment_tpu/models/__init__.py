@@ -1,0 +1,48 @@
+"""Model zoo.
+
+``enhanced_cnn`` is the reference's flagship (``Balanced All-Reduce/
+model.py:52-111``).  The rest form the BASELINE.md config ladder:
+mlp -> lenet5 -> resnet18 -> resnet50 -> bert_base.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def get_model(name: str, **kw: Any):
+    """Build a flax module by registry name (lazy imports keep startup cheap)."""
+    name = name.lower()
+    if name not in MODEL_INPUT_SPECS:
+        raise ValueError(
+            f"unknown model {name!r}; available: {sorted(MODEL_INPUT_SPECS)}")
+    if name == "enhanced_cnn":
+        from .cnn import EnhancedCNNModel
+        return EnhancedCNNModel(**kw)
+    if name == "mlp":
+        from .mlp import MLP
+        return MLP(**kw)
+    if name == "lenet5":
+        from .lenet import LeNet5
+        return LeNet5(**kw)
+    if name == "resnet18":
+        from .resnet import ResNet18
+        return ResNet18(**kw)
+    if name == "resnet50":
+        from .resnet import ResNet50
+        return ResNet50(**kw)
+    if name == "bert_base":
+        from .bert import BertForMLM
+        return BertForMLM(**kw)
+    raise ValueError(f"unknown model {name!r}")
+
+
+MODEL_INPUT_SPECS = {
+    # name -> (example input shape without batch, num_classes or vocab)
+    "enhanced_cnn": ((32, 32, 3), 10),
+    "mlp": ((28, 28, 1), 10),
+    "lenet5": ((28, 28, 1), 10),
+    "resnet18": ((32, 32, 3), 10),
+    "resnet50": ((224, 224, 3), 1000),
+    "bert_base": ((128,), 30522),
+}

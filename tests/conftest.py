@@ -1,0 +1,30 @@
+"""Test harness: force an 8-device virtual CPU mesh before jax imports.
+
+This is the reference-impossible trick that replaces its (absent) test
+strategy: every mesh/psum/ppermute path and all 12 DP sync modes run as
+ordinary pytest cases on one host (SURVEY.md section 4).
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (import after env setup)
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices):
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu import mesh as mesh_lib
+    return mesh_lib.build_mesh({"data": 8})

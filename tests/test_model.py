@@ -1,0 +1,92 @@
+"""EnhancedCNNModel parity tests vs the reference architecture
+(``Balanced All-Reduce/model.py:52-111``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import get_model
+
+# Trainable parameters of the torch reference (convs bias-free, BN affine,
+# final Linear 1024->10 with bias), computed layer-by-layer from
+# model.py:52-111.
+REFERENCE_PARAM_COUNT = 44_595_786
+
+
+@pytest.fixture(scope="module")
+def cnn_vars():
+    model = get_model("enhanced_cnn")
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)),
+                           train=False)
+    return model, variables
+
+
+def _count(tree):
+    return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(tree))
+
+
+def test_param_count_matches_reference(cnn_vars):
+    _, variables = cnn_vars
+    assert _count(variables["params"]) == REFERENCE_PARAM_COUNT
+
+
+def test_batch_stats_present_and_not_trainable(cnn_vars):
+    _, variables = cnn_vars
+    # BN running stats live outside 'params' => excluded from aggregation,
+    # matching torch model.parameters() semantics (communication.py:5,22).
+    assert "batch_stats" in variables
+    # one (mean, var) pair per BN: prep + 8 blocks * (2 or 3 BNs)
+    n_bn = len(jax.tree_util.tree_leaves(variables["batch_stats"])) // 2
+    assert n_bn == 1 + 4 * (3 + 2)  # stride-2 blocks have a shortcut BN
+
+
+def test_forward_shape_and_dtype(cnn_vars):
+    model, variables = cnn_vars
+    x = jax.random.normal(jax.random.key(1), (4, 32, 32, 3))
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (4, 10)
+    assert logits.dtype == jnp.float32
+
+
+def test_train_mode_updates_batch_stats(cnn_vars):
+    model, variables = cnn_vars
+    x = jax.random.normal(jax.random.key(2), (4, 32, 32, 3))
+    logits, mutated = model.apply(variables, x, train=True,
+                                  mutable=["batch_stats"])
+    assert logits.shape == (4, 10)
+    old = variables["batch_stats"]["prep_bn"]["mean"]
+    new = mutated["batch_stats"]["prep_bn"]["mean"]
+    assert not np.allclose(old, new)
+
+
+def test_downsampling_path():
+    # 32 -> 16 -> 8 -> 4 -> 2 spatial; check an intermediate via capture
+    model = get_model("enhanced_cnn")
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)),
+                           train=False)
+    _, state = model.apply(variables, jnp.zeros((2, 32, 32, 3)), train=False,
+                           capture_intermediates=True, mutable=["intermediates"])
+    inter = state["intermediates"]
+    last_block_out = inter["layer4_block1"]["__call__"][0]
+    assert last_block_out.shape == (2, 2, 2, 1024)
+
+
+def test_bfloat16_compute():
+    model = get_model("enhanced_cnn", dtype=jnp.bfloat16)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)),
+                           train=False)
+    # params stay fp32 (flax keeps param dtype fp32 unless param_dtype set)
+    leaf = variables["params"]["prep_conv"]["kernel"]
+    assert leaf.dtype == jnp.float32
+    logits = model.apply(variables, jnp.zeros((2, 32, 32, 3)), train=False)
+    assert logits.dtype == jnp.float32  # head forced to fp32
+
+
+def test_xavier_init_statistics(cnn_vars):
+    _, variables = cnn_vars
+    k = variables["params"]["layer1_block0"]["conv1"]["kernel"]
+    # xavier-uniform bound for 3x3 conv, fan_in=64*9, fan_out=128*9
+    bound = np.sqrt(6.0 / (64 * 9 + 128 * 9))
+    assert float(jnp.max(jnp.abs(k))) <= bound + 1e-6
+    assert float(jnp.std(k)) == pytest.approx(bound / np.sqrt(3), rel=0.1)

@@ -1,0 +1,151 @@
+"""Partition-math parity tests (reference semantics cited per test)."""
+
+import numpy as np
+import pytest
+
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.data import (
+    budget_from_time_limit,
+    contiguous_partition,
+    efficiency_ratios,
+    fixed_classes_for_rank,
+    pack_shard,
+    repartition,
+    skew_partition,
+    skew_repartition,
+    step_budget,
+)
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.data.sources import (
+    load_dataset,
+    train_val_split,
+)
+
+
+class TestEfficiencyRatios:
+    def test_direct_matches_reference_formula(self):
+        # ref: ratio_i = duration_i / sum (dataloader.py:149-151)
+        d = np.array([1.0, 2.0, 3.0, 4.0])
+        r = efficiency_ratios(d, "direct")
+        np.testing.assert_allclose(r, d / d.sum())
+
+    def test_inverse_gives_fast_workers_more(self):
+        r = efficiency_ratios(np.array([1.0, 2.0]), "inverse")
+        assert r[0] > r[1]
+        np.testing.assert_allclose(r.sum(), 1.0)
+
+    def test_uniform(self):
+        r = efficiency_ratios(np.array([5.0, 1.0, 3.0]), "uniform")
+        np.testing.assert_allclose(r, [1 / 3] * 3)
+
+
+class TestContiguousPartition:
+    def test_slice_sizes_proportional(self):
+        # ref: num = int(total * ratio), contiguous (dataloader.py:53-75)
+        parts = contiguous_partition(100, np.array([0.1, 0.2, 0.3, 0.4]))
+        assert [len(p) for p in parts] == [10, 20, 30, 40]
+        assert parts[1][0] == 10 and parts[2][0] == 30
+
+    def test_floor_leaves_tail_unassigned_like_reference(self):
+        parts = contiguous_partition(10, np.array([0.33, 0.33, 0.34]))
+        assert [len(p) for p in parts] == [3, 3, 3]  # int() floors; 1 unused
+
+    def test_disjoint(self):
+        parts = contiguous_partition(1000, np.array([0.25] * 4))
+        allidx = np.concatenate(parts)
+        assert len(np.unique(allidx)) == len(allidx)
+
+
+class TestRepartition:
+    def test_sizes_and_mix(self):
+        # ref dataloader.py:77-104: size = int(total*ratio), prev/next split
+        rng = np.random.default_rng(0)
+        prev = np.arange(100)
+        out = repartition(1000, prev, 0.1, 0.5, 0.5, rng)
+        assert len(out) == 100
+        n_from_prev = np.isin(out[:50], prev).sum()
+        assert n_from_prev == 50  # first half drawn from prev indices
+
+    def test_without_replacement_unique(self):
+        rng = np.random.default_rng(1)
+        out = repartition(500, np.arange(50), 0.1, 0.5, 0.5, rng, replace=False)
+        assert len(np.unique(out)) == len(out)
+
+    def test_with_replacement_allowed_duplicates(self):
+        # disbalanced variants sample with replacement (ref :123,129)
+        rng = np.random.default_rng(2)
+        out = repartition(100, np.arange(10), 0.9, 0.5, 0.5, rng, replace=True)
+        assert len(out) == 90  # duplicates permitted, size preserved
+
+
+class TestDisbalanced:
+    def test_fixed_classes_formula(self):
+        # ref: [(rank*2)%10, (rank*2+1)%10] (Disbalanced .../dataloader.py:77-78)
+        assert fixed_classes_for_rank(0) == [0, 1]
+        assert fixed_classes_for_rank(4) == [8, 9]
+        assert fixed_classes_for_rank(5) == [0, 1]  # wraps mod 10
+
+    def test_skew_partition_reaches_ratio(self):
+        rng = np.random.default_rng(0)
+        labels = np.tile(np.arange(10), 100)  # 1000 samples, balanced
+        base = np.arange(200)
+        out = skew_partition(labels, base, [0, 1], 0.5, rng)
+        assert len(out) == len(base)
+        frac = np.isin(labels[out], [0, 1]).mean()
+        assert frac == pytest.approx(0.5, abs=0.01)
+
+    def test_skew_repartition_maintains_ratio(self):
+        rng = np.random.default_rng(0)
+        labels = np.tile(np.arange(10), 100)
+        fresh = repartition(1000, np.arange(100), 0.2, 0.5, 0.5, rng,
+                            replace=True)
+        out = skew_repartition(labels, fresh, [2, 3], 0.5, rng)
+        assert len(out) == len(fresh)
+        frac = np.isin(labels[out], [2, 3]).mean()
+        assert frac >= 0.49
+
+    def test_skew_noop_when_already_skewed(self):
+        rng = np.random.default_rng(0)
+        labels = np.zeros(100, np.int64)  # everything class 0
+        out = skew_repartition(labels, np.arange(50), [0, 1], 0.5, rng)
+        assert sorted(out) == list(range(50))
+
+
+class TestStepBudget:
+    def test_max_over_workers(self):
+        assert step_budget([100, 230, 64], 64) == 4  # ceil(230/64)
+
+    def test_time_limit_caps_budget(self):
+        # straggler protocol as a budget (SURVEY.md 2.5.4 redesign)
+        assert budget_from_time_limit(100, probe_sec_per_batch=1.0,
+                                      time_limit=60.0) == 60
+        assert budget_from_time_limit(10, 1.0, 60.0) == 10
+
+    def test_pack_shard_masks_padding(self):
+        imgs = np.arange(20, dtype=np.float32).reshape(20, 1, 1, 1)
+        labels = np.arange(20) % 3
+        x, y, m = pack_shard(imgs, labels, np.arange(10), batch_size=4,
+                             num_steps=3)
+        assert x.shape == (3, 4, 1, 1, 1)
+        assert m.sum() == 10  # 10 real examples, 2 masked pads
+        assert m[2, 2] == 0 and m[2, 1] == 1
+
+
+class TestSources:
+    def test_synthetic_cifar_learnable_structure(self):
+        train, test = load_dataset("cifar10", data_dir="/nonexistent",
+                                   limit_train=2000, limit_test=400)
+        assert train.images.shape == (2000, 32, 32, 3)
+        assert test.num_classes == 10
+        # normalized with train stats
+        assert abs(train.images.mean()) < 0.05
+        # class structure: per-class means differ (nearest-centroid beats chance)
+        cents = np.stack([train.images[train.labels == c].mean(0)
+                          for c in range(10)])
+        d = ((test.images[:, None] - cents[None]) ** 2).sum((2, 3, 4))
+        acc = (d.argmin(1) == test.labels).mean()
+        assert acc > 0.5
+
+    def test_train_val_split(self):
+        train, _ = load_dataset("cifar10", data_dir="/nonexistent",
+                                limit_train=1000, limit_test=10)
+        tr, va = train_val_split(train, 0.2, seed=0)
+        assert len(tr) == 800 and len(va) == 200
