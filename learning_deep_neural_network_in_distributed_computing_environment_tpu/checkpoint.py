@@ -1,0 +1,66 @@
+"""Checkpoint / resume.
+
+The reference has **no checkpointing** — the model lives only in memory and
+nothing but PNGs is ever written (SURVEY.md section 5).  This module is the
+documented beyond-reference improvement: the full worker-stacked
+``TrainState`` (params, BN stats, Adam moments, LR clock, RNG) plus the
+global-epoch cursor are serialized with flax msgpack, so a run can resume
+mid-experiment with every worker's local state intact.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Optional
+
+import jax
+import numpy as np
+from flax import serialization
+
+_CKPT_RE = re.compile(r"ckpt_(\d+)\.msgpack$")
+
+
+def save_checkpoint(ckpt_dir: str, state, global_epoch: int,
+                    keep: int = 3) -> str:
+    """Write ``ckpt_<global_epoch>.msgpack``; prune to the newest ``keep``."""
+    os.makedirs(ckpt_dir, exist_ok=True)
+    host_state = jax.device_get(state)
+    payload = {"state": host_state, "global_epoch": global_epoch}
+    path = os.path.join(ckpt_dir, f"ckpt_{global_epoch}.msgpack")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(serialization.to_bytes(payload))
+    os.replace(tmp, path)  # atomic publish
+    for old in sorted(_list(ckpt_dir))[:-keep]:
+        os.remove(os.path.join(ckpt_dir, f"ckpt_{old}.msgpack"))
+    return path
+
+
+def _list(ckpt_dir: str) -> list[int]:
+    if not os.path.isdir(ckpt_dir):
+        return []
+    out = []
+    for name in os.listdir(ckpt_dir):
+        m = _CKPT_RE.match(name)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
+    epochs = _list(ckpt_dir)
+    if not epochs:
+        return None
+    return os.path.join(ckpt_dir, f"ckpt_{max(epochs)}.msgpack")
+
+
+def restore_checkpoint(path: str, state_template):
+    """Restore (state, global_epoch) from a checkpoint file.  The template
+    provides the pytree structure/shapes (e.g. a freshly initialized
+    TrainState)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    payload = serialization.from_bytes(
+        {"state": state_template, "global_epoch": 0}, data)
+    return payload["state"], int(payload["global_epoch"])

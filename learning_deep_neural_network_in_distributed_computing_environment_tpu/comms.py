@@ -1,0 +1,122 @@
+"""The synchronization matrix: one pytree-level ``aggregate`` for all 12 DP
+sync modes.
+
+The reference splits this across three ``communication.py`` flavors with
+asymmetric interfaces (model-level for all-reduce,
+``Balanced All-Reduce/communication.py:4-31``; tensor-level with the trainer
+iterating parameters for ring/double-ring,
+``Balanced Ring/communication.py:5-62``, ``Balanced Double-Ring/
+communication.py:5-77``) over two backends (torch.distributed, mpi4py).
+Here it is a single pure function on pytrees, executed *inside*
+``shard_map``/``jit`` with XLA collectives over the mesh's data axis:
+
+- ``allreduce`` -> ``lax.pmean`` / ``lax.psum`` (NCCL/gloo all_reduce
+  equivalent, rides ICI);
+- ``ring``      -> ``lax.ppermute`` shift-by-1 (the reference's 1-neighbor
+  Isend/Irecv gossip, ``Balanced Ring/communication.py:19-25``);
+- ``double_ring`` -> two ``ppermute`` shifts (1 and 2) (2-neighbor gossip,
+  ``Balanced Double-Ring/communication.py:5-40``).
+
+Semantics notes (SURVEY.md 2.5):
+
+- "Ring" is one gossip exchange per sync — NOT a reduce-scatter/all-gather
+  ring all-reduce; consensus emerges over repeated global epochs.  That is
+  the observable behavior being reproduced.
+- The reference's ring gossip silently no-ops on GPU (2.5.2); the behavior
+  matched here is the correct CPU path.
+- ``weighted`` all-reduce (2.5.10): ``new = w*own + (1-w)*(sum-own)/(N-1)``
+  — the self-exclusive peer mean blended with the own value.  The reference
+  divides by zero when N == 1; here N == 1 returns the own value unchanged
+  (every topology is the identity on a single worker).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .mesh import DATA_AXIS
+
+PyTree = Any
+
+TOPOLOGIES = ("allreduce", "ring", "double_ring")
+HOWS = ("equal", "weighted")
+BYS = ("gradients", "weights")
+
+
+def _shift(x: jnp.ndarray, n: int, shift: int, axis_name: str) -> jnp.ndarray:
+    """Receive the value of ``rank - shift`` (mod n): each rank i sends to
+    ``i + shift``, matching the reference's Isend(to rank+1)/Irecv(from
+    rank-1) gossip pattern."""
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def aggregate(tree: PyTree, *, how: str = "equal",
+              topology: str = "allreduce", local_weight: float = 0.5,
+              axis_name: str = DATA_AXIS) -> PyTree:
+    """Aggregate a per-worker pytree across the data axis.
+
+    Must be called inside ``shard_map`` (or any context where ``axis_name``
+    is bound).  Works on parameter or gradient pytrees alike — the
+    gradients/weights choice ("aggregation_by") is the caller's, matching
+    the reference's dispatch (``Balanced All-Reduce/trainer.py:141-150``).
+    """
+    if how not in HOWS:
+        raise ValueError(f"how must be one of {HOWS}, got {how!r}")
+    if topology not in TOPOLOGIES:
+        raise ValueError(f"topology must be one of {TOPOLOGIES}, got {topology!r}")
+    n = lax.axis_size(axis_name)
+    if n == 1:
+        return tree
+    w = local_weight
+
+    def per_leaf(x: jnp.ndarray) -> jnp.ndarray:
+        if topology == "allreduce":
+            if how == "equal":
+                return lax.pmean(x, axis_name)
+            total = lax.psum(x, axis_name)
+            peers_mean = (total - x) / (n - 1)
+            return w * x + (1.0 - w) * peers_mean
+        if topology == "ring":
+            r = _shift(x, n, 1, axis_name)
+            if how == "equal":
+                return (x + r) / 2.0
+            return w * x + (1.0 - w) * r
+        # double_ring: blend with the two predecessors
+        r1 = _shift(x, n, 1, axis_name)
+        r2 = _shift(x, n, 2, axis_name)
+        if how == "equal":
+            return (x + r1 + r2) / 3.0
+        return w * x + ((1.0 - w) / 2.0) * (r1 + r2)
+
+    return jax.tree_util.tree_map(per_leaf, tree)
+
+
+def make_host_aggregator(mesh, *, how: str, topology: str,
+                         local_weight: float = 0.5):
+    """Jitted stand-alone aggregator over worker-stacked pytrees.
+
+    Takes pytrees whose leaves carry a leading worker axis of size
+    ``mesh.shape['data']`` (the framework's representation of N independent
+    local-SGD replicas) and returns the synchronized pytree.  The train loop
+    fuses aggregation into its round program; this wrapper exists for tests
+    and for ad-hoc use (e.g. federated averaging of checkpoints).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(DATA_AXIS)
+
+    def _agg(tree):
+        def inner(shard):
+            squeezed = jax.tree_util.tree_map(lambda x: x[0], shard)
+            out = aggregate(squeezed, how=how, topology=topology,
+                            local_weight=local_weight)
+            return jax.tree_util.tree_map(lambda x: x[None], out)
+        return jax.shard_map(
+            inner, mesh=mesh, in_specs=(spec,), out_specs=spec)(tree)
+
+    return jax.jit(_agg)

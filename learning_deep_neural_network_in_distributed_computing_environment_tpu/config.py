@@ -187,8 +187,12 @@ def config_from_args(argv: list[str] | None = None) -> Config:
     args = build_argparser().parse_args(argv)
     import os
     if args.device:
-        # explicit CLI choice overrides any inherited JAX_PLATFORMS
+        # explicit CLI choice overrides any inherited JAX_PLATFORMS; an
+        # out-of-tree plugin may have pinned the platform via jax.config at
+        # interpreter start (env var alone would be ignored), so set both
         os.environ["JAX_PLATFORMS"] = args.device
+        import jax
+        jax.config.update("jax_platforms", args.device)
     field_names = {f.name for f in dataclasses.fields(Config)}
     kw = {k: v for k, v in vars(args).items() if k in field_names}
     kw["augment"] = not args.no_augment

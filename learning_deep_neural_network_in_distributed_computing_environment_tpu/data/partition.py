@@ -199,8 +199,10 @@ def pack_shard(images: np.ndarray, labels: np.ndarray, indices: np.ndarray,
     """Materialize one worker's epoch as fixed-shape arrays.
 
     Returns (x [num_steps, B, ...], y [num_steps, B], mask [num_steps, B])
-    where mask is 0 for padding examples.  Padding repeats index 0 so shapes
-    stay static for jit; the mask zeroes its loss/metric contribution.
+    where mask is 0 for padding examples.  Padding wraps around the worker's
+    own real samples so shapes stay static for jit without skewing BatchNorm
+    batch statistics toward one sample; the mask zeroes loss/metric
+    contributions.
     """
     idx = np.asarray(indices)
     n = len(idx)
@@ -208,7 +210,8 @@ def pack_shard(images: np.ndarray, labels: np.ndarray, indices: np.ndarray,
     if n >= cap:
         take, mask = idx[:cap], np.ones(cap, np.float32)
     else:
-        pad = np.zeros(cap - n, np.int64) if n == 0 else np.full(cap - n, idx[0])
+        pad = (np.zeros(cap - n, np.int64) if n == 0
+               else idx[np.arange(cap - n) % n])
         take = np.concatenate([idx, pad])
         mask = np.concatenate([np.ones(n, np.float32),
                                np.zeros(cap - n, np.float32)])

@@ -1,0 +1,261 @@
+"""``train_global``: the orchestration loop.
+
+Host-side control flow around the compiled round program, reproducing the
+reference's global-epoch loop (``Balanced All-Reduce/trainer.py:11-192``):
+
+1. timing probe -> shard-share ratios (``dataloader.py:119-153``);
+2. proportional contiguous partition of train AND val sets
+   (``dataloader.py:41-46``), with non-IID skew in disbalanced mode;
+3. per global epoch: run the compiled round (epochs_local local epochs +
+   per-epoch validation + the sync point), collect metrics;
+4. straggler ``time_limit`` as a per-worker step cap (SURVEY.md 2.5.4
+   redesign of the finish-flag protocol);
+5. measure round duration, re-partition every worker's shard from
+   (prev_fraction x own previous indices) + (next_fraction x global pool)
+   (``trainer.py:179-188``, ``dataloader.py:77-117``).
+
+Returns the reference's twelve metric structures under their original names
+(``trainer.py:192``) plus the final state.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Sequence
+
+import jax
+import numpy as np
+
+from . import probe as probe_lib
+from .config import Config
+from .data import (
+    budget_from_time_limit,
+    contiguous_partition,
+    efficiency_ratios,
+    fixed_classes_for_rank,
+    load_dataset,
+    pack_shard,
+    repartition,
+    skew_partition,
+    skew_repartition,
+    step_budget,
+    train_val_split,
+)
+from . import checkpoint as ckpt_lib
+from .mesh import DATA_AXIS, build_mesh, initialize_distributed
+from .models import get_model
+from .train import LocalSGDEngine, TrainState, rank0_variables
+
+log = logging.getLogger(__name__)
+
+
+def _round_up(x: int, mult: int) -> int:
+    return ((x + mult - 1) // mult) * mult if x else mult
+
+
+def build_model_for(cfg: Config, num_classes: int):
+    import jax.numpy as jnp
+    dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
+    if cfg.dtype != "float32":
+        raise NotImplementedError(
+            "param dtype other than float32 is not supported yet; use "
+            "--compute_dtype for bfloat16 activations/matmuls")
+    return get_model(cfg.model, num_classes=num_classes, dtype=dtype)
+
+
+def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
+                 datasets=None, progress: bool = True) -> dict[str, Any]:
+    """Run the full experiment; returns the reference's metric structures.
+
+    ``simulated_durations``: inject per-worker probe durations (tests /
+    heterogeneity experiments on homogeneous hardware).
+    ``datasets``: optional (train, val, test) ``Dataset`` triple override.
+    """
+    initialize_distributed()
+    if mesh is None:
+        axes = cfg.mesh_axes()
+        if cfg.num_workers:
+            axes[DATA_AXIS] = cfg.num_workers
+        mesh = build_mesh(axes)
+    n = mesh.shape[DATA_AXIS]
+    rng = np.random.default_rng(cfg.seed)
+
+    # --- data ---------------------------------------------------------
+    if datasets is None:
+        full_train, test = load_dataset(
+            cfg.dataset, cfg.data_dir, cfg.seed,
+            cfg.limit_train_samples, cfg.limit_eval_samples)
+        trainset, valset = train_val_split(full_train, 0.2, cfg.seed)
+    else:
+        trainset, valset, test = datasets
+    num_classes = trainset.num_classes
+    batch = cfg.batch_size
+
+    # --- model + engine -------------------------------------------------
+    model = build_model_for(cfg, num_classes)
+    engine = LocalSGDEngine(model, mesh, cfg)
+    sample = trainset.images[:batch]
+    state = engine.init_state(jax.random.key(cfg.seed), sample)
+
+    # --- resume (beyond-reference; no-op when checkpointing is off) ------
+    start_epoch = 0
+    if cfg.checkpoint_dir and cfg.resume:
+        latest = ckpt_lib.latest_checkpoint(cfg.checkpoint_dir)
+        if latest:
+            state, start_epoch = ckpt_lib.restore_checkpoint(latest, state)
+            log.info("resumed from %s at global epoch %d", latest, start_epoch)
+
+    # --- probe -> ratios -> initial partition ---------------------------
+    init_vars = rank0_variables(state)
+    durations, sec_per_batch = probe_lib.estimate_epoch_duration(
+        model, init_vars, sample, n, cfg.probe_batches, simulated_durations)
+    ratios = efficiency_ratios(durations, cfg.proportionality)
+    log.info("probe durations %s -> ratios %s", durations, ratios)
+
+    train_parts = contiguous_partition(len(trainset), ratios)
+    val_parts = contiguous_partition(len(valset), ratios)
+    fixed_classes = None
+    if cfg.data_mode == "disbalanced":
+        fixed_classes = [fixed_classes_for_rank(r, num_classes)
+                         for r in range(n)]
+        train_parts = [
+            skew_partition(trainset.labels, p, fixed_classes[r],
+                           cfg.fixed_ratio, rng)
+            for r, p in enumerate(train_parts)]
+        val_parts = [
+            skew_partition(valset.labels, p, fixed_classes[r],
+                           cfg.fixed_ratio, rng)
+            for r, p in enumerate(val_parts)]
+
+    # --- reference metric structures (trainer.py:13-25) -----------------
+    results: dict[str, Any] = {
+        "all_workers_losses": [[] for _ in range(n)],
+        "all_epochs_losses": [],
+        "global_epoch_losses": [],
+        "global_epoch_accuracies": [],
+        "global_train_losses": [],
+        "global_train_accuracies": [],
+        "global_val_losses": [],
+        "global_val_accuracies": [],
+        "worker_specific_train_losses": [],
+        "worker_specific_train_accuracies": [],
+        "worker_specific_val_losses": [],
+        "worker_specific_val_accuracies": [],
+    }
+
+    def pack_all(ds, parts, caps=None):
+        sizes = [len(p) for p in parts]
+        if caps is not None:
+            sizes = [min(s, c * batch) for s, c in zip(sizes, caps)]
+        steps = _round_up(step_budget(sizes, batch), 4)
+        xs, ys, ms = zip(*(
+            pack_shard(ds.images, ds.labels,
+                       p if caps is None else p[:caps[i] * batch],
+                       batch, steps)
+            for i, p in enumerate(parts)))
+        return np.stack(xs), np.stack(ys), np.stack(ms)
+
+    # --- optional profiler trace (beyond-reference, SURVEY.md section 5) --
+    profiling = False
+    if cfg.profile_dir:
+        try:
+            jax.profiler.start_trace(cfg.profile_dir)
+            profiling = True
+        except Exception as e:  # some PJRT plugins lack profiler support
+            log.warning("profiler unavailable: %s", e)
+
+    # --- the global-epoch loop ------------------------------------------
+    for global_epoch in range(start_epoch, cfg.epochs_global):
+        # straggler protocol: per-worker step cap from the probe's
+        # sec/batch and the time_limit grace budget
+        caps = [budget_from_time_limit(
+            int(np.ceil(len(p) / batch)), float(sec_per_batch[i]),
+            cfg.time_limit) for i, p in enumerate(train_parts)]
+        t0 = time.perf_counter()
+        state, mx = engine.round(
+            state, pack_all(trainset, train_parts, caps),
+            pack_all(valset, val_parts))
+        wall = time.perf_counter() - t0
+
+        # --- metric assembly (trainer.py:49-171 semantics) --------------
+        # mx arrays: batch_losses [N, E, S], batch_mask [N, E, S],
+        # train/val loss/acc [N, E], avg_acc [N, E], global_* [N]
+        bl, bm = mx["batch_losses"], mx["batch_mask"]
+        epochs_local = bl.shape[1]
+        current_losses: list[float] = []
+        for e in range(epochs_local):
+            epoch_all_workers: list[float] = []
+            for i in range(n):
+                valid = bl[i, e][bm[i, e] > 0].tolist()
+                results["all_workers_losses"][i].extend(valid)
+                epoch_all_workers.extend(valid)
+            results["all_epochs_losses"].append(epoch_all_workers)
+            current_losses.extend(epoch_all_workers)
+        results["global_epoch_losses"].append(current_losses)
+        results["global_epoch_accuracies"].append(
+            mx["avg_acc"][0].tolist())
+        results["global_train_losses"].append(float(mx["global_train_loss"][0]))
+        results["global_train_accuracies"].append(float(mx["global_train_acc"][0]))
+        results["global_val_losses"].append(float(mx["global_val_loss"][0]))
+        results["global_val_accuracies"].append(float(mx["global_val_acc"][0]))
+        # rank-0 per-local-epoch curves (trainer.py:122-126)
+        results["worker_specific_train_losses"].extend(
+            mx["train_loss"][0].tolist())
+        results["worker_specific_train_accuracies"].extend(
+            mx["train_acc"][0].tolist())
+        results["worker_specific_val_losses"].extend(
+            mx["val_loss"][0].tolist())
+        results["worker_specific_val_accuracies"].extend(
+            mx["val_acc"][0].tolist())
+
+        if progress:
+            print(f"Global Epoch {global_epoch + 1}/{cfg.epochs_global}: "
+                  f"loss={results['global_train_losses'][-1]:.4f} "
+                  f"acc={results['global_train_accuracies'][-1]:.2f}% "
+                  f"val_loss={results['global_val_losses'][-1]:.4f} "
+                  f"val_acc={results['global_val_accuracies'][-1]:.2f}% "
+                  f"({wall:.1f}s)")
+
+        # --- re-partition (trainer.py:179-188) ---------------------------
+        # per-worker round durations: simulated spread if provided, else the
+        # measured wall time (uniform on homogeneous SPMD hardware)
+        round_durations = (np.asarray(simulated_durations, np.float64)
+                           if simulated_durations is not None
+                           else np.full(n, wall))
+        new_ratios = efficiency_ratios(round_durations, cfg.proportionality)
+        replace = cfg.data_mode == "disbalanced"
+        train_parts = [
+            repartition(len(trainset), train_parts[i], new_ratios[i],
+                        cfg.prev_fraction, cfg.next_fraction, rng,
+                        replace=replace)
+            for i in range(n)]
+        val_parts = [
+            repartition(len(valset), val_parts[i], new_ratios[i],
+                        cfg.prev_fraction, cfg.next_fraction, rng,
+                        replace=replace)
+            for i in range(n)]
+        if cfg.data_mode == "disbalanced":
+            train_parts = [
+                skew_repartition(trainset.labels, p, fixed_classes[i],
+                                 cfg.fixed_ratio, rng)
+                for i, p in enumerate(train_parts)]
+            val_parts = [
+                skew_repartition(valset.labels, p, fixed_classes[i],
+                                 cfg.fixed_ratio, rng)
+                for i, p in enumerate(val_parts)]
+
+        if (cfg.checkpoint_dir and cfg.checkpoint_every
+                and (global_epoch + 1) % cfg.checkpoint_every == 0
+                and jax.process_index() == 0):
+            ckpt_lib.save_checkpoint(cfg.checkpoint_dir, state,
+                                     global_epoch + 1)
+
+    if profiling:
+        jax.profiler.stop_trace()
+
+    results["state"] = state
+    results["mesh"] = mesh
+    results["model"] = model
+    results["test"] = test if datasets is None else datasets[2]
+    return results

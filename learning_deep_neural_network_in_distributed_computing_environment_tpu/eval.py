@@ -1,0 +1,96 @@
+"""Evaluation: the reference's ``validator.py`` + ``evaluator.py``
+capabilities.
+
+- per-local-epoch validation runs *inside* the compiled round program
+  (train.py ``eval_step``), matching ``validator.py:3-23``;
+- ``evaluate`` here is the rank-0 final test-set pass
+  (``evaluator.py:6-61``): loss, accuracy, and precision/recall/F1 in
+  macro, weighted, and micro averages, with the reference's printed lines
+  (including its 'Micro recision'/'Micro ecall' typos normalized — noted).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .train import softmax_cross_entropy
+
+
+def _prf(labels: np.ndarray, preds: np.ndarray, num_classes: int,
+         average: str):
+    """precision/recall/F1 without a sklearn dependency (numerically
+    validated against sklearn in tests; sklearn semantics: undefined -> 0)."""
+    tp = np.zeros(num_classes)
+    fp = np.zeros(num_classes)
+    fn = np.zeros(num_classes)
+    for c in range(num_classes):
+        tp[c] = np.sum((preds == c) & (labels == c))
+        fp[c] = np.sum((preds == c) & (labels != c))
+        fn[c] = np.sum((preds != c) & (labels == c))
+    with np.errstate(divide="ignore", invalid="ignore"):
+        prec = np.where(tp + fp > 0, tp / (tp + fp), 0.0)
+        rec = np.where(tp + fn > 0, tp / (tp + fn), 0.0)
+        f1 = np.where(prec + rec > 0, 2 * prec * rec / (prec + rec), 0.0)
+    if average == "macro":
+        return prec.mean(), rec.mean(), f1.mean()
+    if average == "weighted":
+        support = np.bincount(labels, minlength=num_classes).astype(np.float64)
+        w = support / support.sum()
+        return (prec * w).sum(), (rec * w).sum(), (f1 * w).sum()
+    if average == "micro":
+        p = tp.sum() / max(tp.sum() + fp.sum(), 1)
+        r = tp.sum() / max(tp.sum() + fn.sum(), 1)
+        f = 2 * p * r / max(p + r, 1e-12) if (p + r) > 0 else 0.0
+        return p, r, f
+    raise ValueError(f"unknown average {average!r}")
+
+
+def evaluate(model, variables, images: np.ndarray, labels: np.ndarray,
+             batch_size: int, *, rank: int = 0, verbose: bool = True):
+    """Full test-set evaluation (ref evaluator.py:6-61).
+
+    Returns (loss, accuracy, all_preds, all_labels, metrics_dict).
+    Batching pads the tail batch and masks it out (static shapes for jit).
+    """
+    from .data.partition import pack_shard
+    n = len(labels)
+    steps = int(np.ceil(n / batch_size))
+    x, y, m = pack_shard(images, labels, np.arange(n), batch_size, steps)
+
+    @jax.jit
+    def run(x, y, m):
+        def step(_, inp):
+            xb, yb, mb = inp
+            out = model.apply(variables, xb, train=False)
+            ce = softmax_cross_entropy(out, yb)
+            # reference loss is the mean of per-batch means
+            # (evaluator.py:22,33); batches are equal-size here so the
+            # example mean is identical up to tail masking
+            return _, (out.argmax(-1), (ce * mb).sum(), ((out.argmax(-1) == yb) * mb).sum())
+        _, (preds, lsums, csums) = jax.lax.scan(step, 0, (x, y, m))
+        return preds, lsums.sum(), csums.sum()
+
+    preds, loss_sum, correct = jax.device_get(run(
+        jnp.asarray(x), jnp.asarray(y), jnp.asarray(m)))
+    preds = preds.reshape(-1)[:n]
+    loss = float(loss_sum) / n
+    accuracy = 100.0 * float(correct) / n
+
+    ncls = int(max(labels.max(), preds.max())) + 1
+    pm, rm, fm = _prf(labels, preds, ncls, "macro")
+    pw, rw, fw = _prf(labels, preds, ncls, "weighted")
+    pi, ri, fi = _prf(labels, preds, ncls, "micro")
+    metrics = dict(precision_macro=pm, recall_macro=rm, f1_macro=fm,
+                   precision_weighted=pw, recall_weighted=rw, f1_weighted=fw,
+                   precision_micro=pi, recall_micro=ri, f1_micro=fi)
+    if verbose:
+        # same report lines as evaluator.py:55-59
+        print(f"Worker {rank}, Test Loss: {loss:.4f}, Test Accuracy: "
+              f"{accuracy:.2f}%, Weighted Precision: {pw:.2f}, Weighted "
+              f"Recall: {rw:.2f}, Weighted F1 Score: {fw:.2f}")
+        print(f"Precision: {pm:.2f}, Recall: {rm:.2f}, F1 Score: {fm:.2f}")
+        print(f"Micro Precision: {pi:.2f}, Micro Recall: {ri:.2f}, "
+              f"Micro F1 Score: {fi:.2f}")
+    return loss, accuracy, preds, labels, metrics

@@ -1,0 +1,49 @@
+"""CLI entrypoint — the reference's six ``main.py`` variants as one command
+(``Balanced All-Reduce/main.py:17-99``).
+
+Run flow parity: init distributed -> build model (Xavier init, broadcast) ->
+loaders (probe + partition) -> train_global -> rank-0 test evaluation with
+P/R/F1 -> the six plots -> teardown.  Topology and data mode select the
+variant (the reference selects by directory).
+
+Example::
+
+    python -m learning_deep_neural_network_in_distributed_computing_environment_tpu.main \
+        --epochs_global 2 --epochs_local 2 --topology ring --data_mode disbalanced
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+
+def main(argv=None) -> int:
+    from .config import config_from_args
+    cfg = config_from_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, cfg.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s")
+
+    import jax
+    from . import viz
+    from .driver import train_global
+    from .eval import evaluate
+
+    results = train_global(cfg)
+
+    # rank-0 final test evaluation (ref main.py:61-62)
+    if jax.process_index() == 0:
+        from .train import rank0_variables
+        variables = rank0_variables(results["state"])
+        test = results["test"]
+        evaluate(results["model"], variables, test.images, test.labels,
+                 cfg.batch_size, rank=0)
+        # the six plots (ref main.py:65-77)
+        viz.write_all(results, cfg.epochs_global, cfg.epochs_local,
+                      cfg.out_dir)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,37 @@
+"""LeNet-5 (BASELINE.md config ladder entry 2: MNIST, 4-way DP).
+
+Classic LeCun architecture adapted to NHWC/TPU: conv 6@5x5 -> avgpool ->
+conv 16@5x5 -> avgpool -> dense 120 -> 84 -> classes, tanh activations.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_xavier = nn.initializers.xavier_uniform()
+
+
+class LeNet5(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        x = jnp.asarray(x, self.dtype)
+        x = nn.Conv(6, (5, 5), padding="SAME", kernel_init=_xavier,
+                    dtype=self.dtype)(x)
+        x = nn.tanh(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(16, (5, 5), padding="VALID", kernel_init=_xavier,
+                    dtype=self.dtype)(x)
+        x = nn.tanh(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape(x.shape[0], -1)
+        x = nn.tanh(nn.Dense(120, kernel_init=_xavier, dtype=self.dtype)(x))
+        x = nn.tanh(nn.Dense(84, kernel_init=_xavier, dtype=self.dtype)(x))
+        x = nn.Dense(self.num_classes, kernel_init=_xavier,
+                     dtype=jnp.float32)(jnp.asarray(x, jnp.float32))
+        return x

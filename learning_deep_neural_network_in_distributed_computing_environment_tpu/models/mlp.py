@@ -1,0 +1,31 @@
+"""2-layer MLP (BASELINE.md config ladder entry 1: MNIST, single-process).
+
+Accepts either flat [B, D] or image [B, H, W, C] inputs (flattened).  Shares
+the engine's (train, mutable batch_stats) calling convention; has no
+BatchNorm so ``batch_stats`` is simply absent.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_xavier = nn.initializers.xavier_uniform()
+
+
+class MLP(nn.Module):
+    num_classes: int = 10
+    hidden: int = 256
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
+        x = x.reshape(x.shape[0], -1)
+        x = jnp.asarray(x, self.dtype)
+        x = nn.Dense(self.hidden, kernel_init=_xavier, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, kernel_init=_xavier,
+                     dtype=jnp.float32)(jnp.asarray(x, jnp.float32))
+        return x

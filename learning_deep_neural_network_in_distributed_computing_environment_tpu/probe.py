@@ -1,0 +1,90 @@
+"""The heterogeneity timing probe.
+
+Capability parity with ``estimate_epoch_duration``
+(``Balanced All-Reduce/dataloader.py:119-153``): each worker times a fixed
+number of forward+backward batches, durations are gathered across workers,
+and shard-share ratios are derived from them.
+
+TPU-native redesign:
+
+- the timed computation is a *jitted* fwd+bwd (``outputs.sum().backward()``
+  equivalent: grad of the summed logits w.r.t. params), compiled once and
+  excluded from timing — the probe measures steady-state step time, not
+  compilation;
+- gradients never leak into training state (the reference leaves stale
+  grads behind, SURVEY.md 2.5.7 — structurally impossible here since the
+  probe is a pure function);
+- durations are exchanged host-side with
+  ``jax.experimental.multihost_utils.process_allgather`` between rounds,
+  never inside a compiled program (SURVEY.md 7.3 host-side control flow).
+  On a single process all mesh positions share one clock, so the gathered
+  vector is uniform; heterogeneous fleets get real spread, and tests inject
+  ``simulated_durations``.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def measure_step_time(model, variables, sample_batch: np.ndarray,
+                      num_batches: int = 10) -> float:
+    """Seconds for ``num_batches`` jitted fwd+bwd executions (post-compile)."""
+
+    def fwd_bwd(params, rest, x):
+        def loss(p):
+            out = model.apply({"params": p, **rest}, x, train=False)
+            return out.sum()
+        return jax.grad(loss)(params)
+
+    params = variables["params"]
+    rest = {k: v for k, v in variables.items() if k != "params"}
+    fn = jax.jit(fwd_bwd)
+    x = jnp.asarray(sample_batch)
+    jax.block_until_ready(fn(params, rest, x))  # compile
+    t0 = time.perf_counter()
+    for _ in range(num_batches):
+        g = fn(params, rest, x)
+    jax.block_until_ready(g)
+    return time.perf_counter() - t0
+
+
+def gather_durations(local_duration: float, world_size: int,
+                     simulated_durations=None) -> np.ndarray:
+    """All processes' probe durations as a [world_size] vector (ref
+    dataloader.py:139-147).  ``simulated_durations`` overrides for tests and
+    for heterogeneity experiments on homogeneous hardware."""
+    if simulated_durations is not None:
+        d = np.asarray(simulated_durations, np.float64)
+        if d.shape != (world_size,):
+            raise ValueError(
+                f"simulated_durations must have shape ({world_size},), "
+                f"got {d.shape}")
+        return d
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        gathered = multihost_utils.process_allgather(
+            np.asarray([local_duration], np.float64))
+        per_process = np.asarray(gathered).ravel()
+        # jax.devices() orders devices contiguously by process (process 0's
+        # local devices first), so each process's timing covers a contiguous
+        # block of mesh positions
+        reps = int(np.ceil(world_size / per_process.size))
+        return np.repeat(per_process, reps)[:world_size]
+    return np.full(world_size, local_duration, np.float64)
+
+
+def estimate_epoch_duration(model, variables, sample_batch: np.ndarray,
+                            world_size: int, num_batches: int = 10,
+                            simulated_durations=None):
+    """Returns (durations [world_size], sec_per_batch [world_size])."""
+    if simulated_durations is None:
+        local = measure_step_time(model, variables, sample_batch, num_batches)
+    else:
+        local = float(np.asarray(simulated_durations).ravel()[0])
+    durations = gather_durations(local, world_size, simulated_durations)
+    return durations, durations / max(num_batches, 1)

@@ -1,0 +1,311 @@
+"""The training engine: local-SGD rounds as one compiled SPMD program.
+
+Reference semantics being reproduced (``Balanced All-Reduce/trainer.py``):
+
+- two-level loop: ``epochs_global`` rounds x ``epochs_local`` local epochs
+  (``trainer.py:29,46``); workers train independently within a round and
+  synchronize ONCE per round (``:141-150``);
+- the hot loop (``train_local_epoch``, ``:194-223``): per-batch
+  zero_grad/forward/CE/backward/Adam step, per-batch loss capture, accuracy
+  counting, ``scheduler.step()`` once per local epoch (StepLR semantics,
+  ``main.py:54``, SURVEY.md 2.5.6);
+- per-local-epoch validation on the worker's own val shard
+  (``:105-107``; ``validator.py:3-23``);
+- aggregation dispatch on (aggregation_by x aggregation_type x topology)
+  (``:141-150``).  In **weights** mode the averaged parameters replace the
+  local ones (FedAvg).  In **gradients** mode the reference averages the
+  *stale last-batch* gradients and the next epoch's ``zero_grad()`` discards
+  them before any optimizer step — the collectives run but weights are
+  unaffected (SURVEY.md 3.2).  That observable behavior is kept: the
+  aggregated-gradient global norm is reported in metrics, parameters are
+  untouched;
+- Adam moments stay local across rounds, BatchNorm statistics are never
+  synchronized (SURVEY.md 7.3).
+
+TPU-first design (not a translation):
+
+- a whole round — ``epochs_local`` x ``steps`` train steps, per-epoch
+  validation, metric reduction, and the sync point — is ONE ``jit`` of a
+  ``shard_map`` over the mesh's ``data`` axis: zero host round-trips inside
+  a round (the reference issues ~7 small collectives per local epoch from
+  Python, ``trainer.py:50-119``);
+- per-worker state (params, BN stats, Adam moments, RNG) is a pytree whose
+  leaves carry a leading worker axis sharded over ``data`` — N independent
+  replicas in SPMD clothing;
+- unequal shard sizes become a fixed per-round step budget with per-example
+  masks (SURVEY.md 7.3), which also hosts the straggler ``time_limit``
+  capability (``data/partition.py:budget_from_time_limit``);
+- per-batch ``.item()`` reads (``trainer.py:212-216``) become on-device
+  metric buffers returned once per round.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import struct
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from . import comms
+from .config import Config
+from .data.augment import augment_batch
+from .mesh import DATA_AXIS
+
+log = logging.getLogger(__name__)
+PyTree = Any
+
+
+class TrainState(struct.PyTreeNode):
+    """Per-worker replica state; every leaf carries a leading worker axis."""
+
+    params: PyTree
+    batch_stats: PyTree
+    opt_state: PyTree        # Adam moments — local to each worker, never synced
+    lr_epoch: jnp.ndarray    # int32, local epochs completed (StepLR clock)
+    rng: jnp.ndarray         # uint32[2] raw PRNG key per worker
+
+
+def rank0_variables(state: "TrainState") -> dict:
+    """Worker-0 slice of a stacked TrainState as model.apply variables —
+    the reference's rank-0 model for test evaluation (main.py:61-62)."""
+    variables = {"params": jax.tree_util.tree_map(lambda x: x[0], state.params)}
+    if jax.tree_util.tree_leaves(state.batch_stats):
+        variables["batch_stats"] = jax.tree_util.tree_map(
+            lambda x: x[0], state.batch_stats)
+    return variables
+
+
+def steplr(lr0: float, gamma: float, step_size: int, epoch: jnp.ndarray):
+    """torch.optim.lr_scheduler.StepLR equivalent, stepped per LOCAL epoch
+    (ref trainer.py:218 + main.py:54; StepLR(step_size=25), default gamma
+    0.1)."""
+    return lr0 * gamma ** (epoch // step_size)
+
+
+def softmax_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray):
+    """Per-example CE, torch nn.CrossEntropyLoss semantics (main.py:52)."""
+    logz = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.take_along_axis(logz, labels[..., None], axis=-1)[..., 0]
+
+
+def _masked_mean(values: jnp.ndarray, mask: jnp.ndarray):
+    return (values * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def _tree_where(pred, a: PyTree, b: PyTree) -> PyTree:
+    return jax.tree_util.tree_map(
+        lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+class LocalSGDEngine:
+    """Builds and caches the jitted round program for one (model, mesh,
+    config) triple."""
+
+    def __init__(self, model, mesh, cfg: Config):
+        self.model = model
+        self.mesh = mesh
+        self.cfg = cfg
+        self.n_workers = mesh.shape[DATA_AXIS]
+        # torch.optim.Adam defaults (betas 0.9/0.999, eps 1e-8); LR applied
+        # outside so StepLR can drive it per local epoch.
+        self.tx = optax.scale_by_adam(b1=0.9, b2=0.999, eps=1e-8)
+        self._round_cache: dict[tuple, Callable] = {}
+        self._spec = P(DATA_AXIS)
+
+    # ------------------------------------------------------------------
+    # State init
+    # ------------------------------------------------------------------
+    def init_state(self, rng: jax.Array, sample_input: np.ndarray) -> TrainState:
+        """Initialize one replica and broadcast it to all workers — the
+        reference's Xavier init + rank-0 ``state_dict`` broadcast, which
+        includes BN buffers (``main.py:33-46``)."""
+        n = self.n_workers
+
+        def _init(key):
+            variables = self.model.init(key, jnp.asarray(sample_input),
+                                        train=False)
+            params = variables["params"]
+            batch_stats = variables.get("batch_stats", {})
+            opt_state = self.tx.init(params)
+            return params, batch_stats, opt_state
+
+        params, batch_stats, opt_state = jax.jit(_init)(rng)
+
+        def tile(tree):
+            return jax.tree_util.tree_map(
+                lambda x: jnp.broadcast_to(x[None], (n, *x.shape)), tree)
+
+        state = TrainState(
+            params=tile(params), batch_stats=tile(batch_stats),
+            opt_state=tile(opt_state),
+            lr_epoch=jnp.zeros((n,), jnp.int32),
+            rng=jax.vmap(lambda i: jax.random.key_data(
+                jax.random.fold_in(jax.random.key(self.cfg.seed), i)))(
+                    jnp.arange(n)),
+        )
+        sharding = NamedSharding(self.mesh, self._spec)
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, sharding), state)
+
+    # ------------------------------------------------------------------
+    # The round program
+    # ------------------------------------------------------------------
+    def _loss_and_metrics(self, params, batch_stats, xb, yb, mb):
+        out, mut = self.model.apply(
+            {"params": params, "batch_stats": batch_stats}, xb, train=True,
+            mutable=["batch_stats"])
+        ce = softmax_cross_entropy(out, yb)
+        loss = _masked_mean(ce, mb)
+        correct = ((out.argmax(-1) == yb) * mb).sum()
+        return loss, (mut.get("batch_stats", batch_stats), correct, mb.sum())
+
+    def _build_round(self, shapes_key):
+        cfg = self.cfg
+        epochs_local = cfg.epochs_local
+        augment = cfg.augment and len(shapes_key[0]) == 5  # [S,B,H,W,C]
+
+        def per_worker(state: TrainState, x, y, m, xv, yv, mv):
+            """One worker's round.  x:[S,B,...] y,m:[S,B]; val likewise."""
+            zero_grads = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+
+            def train_step(carry, inp):
+                params, batch_stats, opt_state, rng, lr = carry[:5]
+                xb, yb, mb = inp
+                rng, k = jax.random.split(jax.random.wrap_key_data(rng))
+                rng = jax.random.key_data(rng)
+                if augment:
+                    xb = augment_batch(k, xb)
+                (loss, (new_bs, correct, total)), grads = jax.value_and_grad(
+                    self._loss_and_metrics, has_aux=True)(
+                        params, batch_stats, xb, yb, mb)
+                updates, new_opt = self.tx.update(grads, opt_state, params)
+                new_params = optax.apply_updates(
+                    params, jax.tree_util.tree_map(lambda u: -lr * u, updates))
+                # fully-masked (padding) steps leave everything untouched —
+                # including the carried last-real-batch grads, so gradients
+                # mode aggregates each worker's stale last REAL gradient
+                # (reference semantics) rather than a padding step's zeros
+                do = total > 0
+                params = _tree_where(do, new_params, params)
+                batch_stats = _tree_where(do, new_bs, batch_stats)
+                opt_state = _tree_where(do, new_opt, opt_state)
+                grads = _tree_where(do, grads, carry[5])
+                return ((params, batch_stats, opt_state, rng, lr, grads),
+                        (loss, correct, total))
+
+            def eval_step(carry, inp):
+                params, batch_stats = carry
+                xb, yb, mb = inp
+                out = self.model.apply(
+                    {"params": params, "batch_stats": batch_stats}, xb,
+                    train=False)
+                ce = softmax_cross_entropy(out, yb)
+                return carry, ((ce * mb).sum(), ((out.argmax(-1) == yb) * mb).sum(),
+                               mb.sum())
+
+            def local_epoch(carry, _):
+                params, batch_stats, opt_state, lr_epoch, rng, _ = carry
+                lr = steplr(cfg.lr, cfg.lr_gamma, cfg.lr_step_size, lr_epoch)
+                (params, batch_stats, opt_state, rng, _, last_grads), \
+                    (losses, corrects, totals) = lax.scan(
+                        train_step,
+                        (params, batch_stats, opt_state, rng, lr, zero_grads),
+                        (x, y, m))
+                # reference per-epoch scalars: loss = mean over real batches
+                # (trainer.py:220), accuracy = 100*correct/total (:221)
+                real_step = (totals > 0).astype(jnp.float32)
+                train_loss = _masked_mean(losses, real_step)
+                train_acc = 100.0 * corrects.sum() / jnp.maximum(
+                    totals.sum(), 1.0)
+                # validation on the worker's own val shard every local epoch
+                # (trainer.py:105-107)
+                _, (vls, vcs, vts) = lax.scan(
+                    eval_step, (params, batch_stats), (xv, yv, mv))
+                val_loss = vls.sum() / jnp.maximum(vts.sum(), 1.0)
+                val_acc = 100.0 * vcs.sum() / jnp.maximum(vts.sum(), 1.0)
+                # cross-worker mean accuracy per local epoch (trainer.py:50-53)
+                avg_acc = lax.pmean(train_acc, DATA_AXIS)
+                lr_epoch = lr_epoch + 1
+                per_epoch = dict(
+                    batch_losses=losses, batch_mask=real_step,
+                    train_loss=train_loss, train_acc=train_acc,
+                    val_loss=val_loss, val_acc=val_acc, avg_acc=avg_acc)
+                return ((params, batch_stats, opt_state, lr_epoch, rng,
+                         last_grads), per_epoch)
+
+            carry0 = (state.params, state.batch_stats, state.opt_state,
+                      state.lr_epoch, state.rng, zero_grads)
+            (params, batch_stats, opt_state, lr_epoch, rng, last_grads), \
+                per_epoch = lax.scan(local_epoch, carry0, None,
+                                     length=epochs_local)
+
+            # --- the sync point (trainer.py:141-150) -----------------------
+            agg_grad_norm = jnp.zeros(())
+            if cfg.aggregation_by == "weights":
+                params = comms.aggregate(
+                    params, how=cfg.aggregation_type, topology=cfg.topology,
+                    local_weight=cfg.local_weight)
+            else:
+                # gradients mode: reference averages stale last-batch grads
+                # which the next zero_grad() discards — collectives run,
+                # weights unchanged (SURVEY.md 3.2).  Report the norm so the
+                # behavior is observable.
+                agg = comms.aggregate(
+                    last_grads, how=cfg.aggregation_type,
+                    topology=cfg.topology, local_weight=cfg.local_weight)
+                agg_grad_norm = optax.global_norm(agg)
+
+            # cross-worker global-epoch metric means (trainer.py:152-162)
+            metrics = dict(
+                per_epoch,
+                agg_grad_norm=agg_grad_norm,
+                global_train_loss=lax.pmean(
+                    per_epoch["train_loss"].mean(), DATA_AXIS),
+                global_train_acc=lax.pmean(
+                    per_epoch["train_acc"].mean(), DATA_AXIS),
+                global_val_loss=lax.pmean(
+                    per_epoch["val_loss"].mean(), DATA_AXIS),
+                global_val_acc=lax.pmean(
+                    per_epoch["val_acc"].mean(), DATA_AXIS),
+            )
+            new_state = TrainState(params=params, batch_stats=batch_stats,
+                                   opt_state=opt_state, lr_epoch=lr_epoch,
+                                   rng=rng)
+            return new_state, metrics
+
+        def stacked(state, x, y, m, xv, yv, mv):
+            squeeze = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
+            expand = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
+            new_state, metrics = per_worker(
+                squeeze(state), *map(lambda a: a[0], (x, y, m, xv, yv, mv)))
+            return expand(new_state), expand(metrics)
+
+        spec = self._spec
+        fn = jax.shard_map(
+            stacked, mesh=self.mesh,
+            in_specs=(spec,) * 7, out_specs=spec)
+        return jax.jit(fn, donate_argnums=(0,))
+
+    def round(self, state: TrainState, train_pack, val_pack):
+        """Run one global epoch.  Packs are numpy stacks
+        (x [N,S,B,...], y [N,S,B], m [N,S,B])."""
+        x, y, m = train_pack
+        xv, yv, mv = val_pack
+        key = (tuple(x.shape[1:]), tuple(xv.shape[1:]))
+        if key not in self._round_cache:
+            log.info("compiling round program for shapes %s", key)
+            self._round_cache[key] = self._build_round(key)
+        sharding = NamedSharding(self.mesh, self._spec)
+        put = lambda a: jax.device_put(jnp.asarray(a), sharding)
+        new_state, metrics = self._round_cache[key](
+            state, put(x), put(y), put(m), put(xv), put(yv), put(mv))
+        # block: keeps at most one collective execution in flight (required
+        # on 1-core CPU hosts where pipelined rendezvous can deadlock)
+        new_state = jax.block_until_ready(new_state)
+        return new_state, jax.device_get(metrics)

@@ -1,26 +1,37 @@
-"""Test harness: force an 8-device virtual CPU mesh before jax imports.
+"""Test harness: force an 8-device virtual CPU mesh.
 
 This is the reference-impossible trick that replaces its (absent) test
 strategy: every mesh/psum/ppermute path and all 12 DP sync modes run as
 ordinary pytest cases on one host (SURVEY.md section 4).
+
+Note: this environment registers an out-of-tree TPU PJRT plugin at
+interpreter start and pins ``jax_platforms`` via ``jax.config`` — an env-var
+override is silently ignored, so the CPU pin must also go through
+``jax.config.update`` after importing jax.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+# XLA flags are read at first backend initialization; set before any
+# jax.devices() call.
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 
-import jax  # noqa: E402  (import after env setup)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()
-    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    assert len(devs) == 8 and devs[0].platform == "cpu", \
+        f"expected 8 virtual CPU devices, got {devs}"
     return devs
 
 

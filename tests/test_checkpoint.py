@@ -1,0 +1,55 @@
+"""Checkpoint/resume round-trip (beyond-reference feature, SURVEY.md 5)."""
+
+import numpy as np
+
+import jax
+
+from learning_deep_neural_network_in_distributed_computing_environment_tpu import checkpoint as C
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import get_model
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.train import LocalSGDEngine
+
+
+def test_save_restore_roundtrip(mesh8, tmp_path):
+    cfg = Config(model="mlp", epochs_local=1, batch_size=8,
+                 compute_dtype="float32", augment=False)
+    engine = LocalSGDEngine(get_model("mlp", num_classes=10, hidden=8),
+                            mesh8, cfg)
+    x = np.zeros((8, 1, 8, 28, 28, 1), np.float32)
+    state = engine.init_state(jax.random.key(0), x[0, 0])
+    path = C.save_checkpoint(str(tmp_path), state, global_epoch=3)
+    assert C.latest_checkpoint(str(tmp_path)) == path
+    template = engine.init_state(jax.random.key(1), x[0, 0])
+    restored, epoch = C.restore_checkpoint(path, template)
+    assert epoch == 3
+    for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_prune_keeps_newest(mesh8, tmp_path):
+    cfg = Config(model="mlp", epochs_local=1, batch_size=8,
+                 compute_dtype="float32", augment=False)
+    engine = LocalSGDEngine(get_model("mlp", num_classes=10, hidden=8),
+                            mesh8, cfg)
+    x = np.zeros((8, 1, 8, 28, 28, 1), np.float32)
+    state = engine.init_state(jax.random.key(0), x[0, 0])
+    for e in range(1, 6):
+        C.save_checkpoint(str(tmp_path), state, e, keep=2)
+    assert C._list(str(tmp_path)) == [4, 5]
+
+
+def test_driver_resume_continues(mesh8, tmp_path):
+    kw = dict(model="mlp", dataset="mnist", epochs_local=1, batch_size=16,
+              limit_train_samples=400, limit_eval_samples=50,
+              compute_dtype="float32", augment=False,
+              aggregation_by="weights", checkpoint_dir=str(tmp_path),
+              checkpoint_every=1, seed=2)
+    res1 = train_global(Config(epochs_global=2, **kw), mesh=mesh8,
+                        progress=False)
+    # resume: run "4 epochs" but the first 2 come from the checkpoint
+    res2 = train_global(Config(epochs_global=4, resume=True, **kw),
+                        mesh=mesh8, progress=False)
+    assert len(res2["global_train_losses"]) == 2  # only epochs 3 and 4 ran
+    assert C._list(str(tmp_path))[-1] == 4

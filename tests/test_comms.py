@@ -1,0 +1,128 @@
+"""Numerical goldens for the 12-mode sync matrix (SURVEY.md 2.3) on the
+8-device CPU mesh, plus the gossip-convergence property."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from learning_deep_neural_network_in_distributed_computing_environment_tpu import comms
+
+N = 8
+
+
+def worker_values():
+    """Distinct per-worker pytrees: worker i holds {a: i, b: [i, i+0.5]}."""
+    return {
+        "a": jnp.arange(N, dtype=jnp.float32).reshape(N, 1),
+        "b": jnp.stack([jnp.arange(N, dtype=jnp.float32),
+                        jnp.arange(N, dtype=jnp.float32) + 0.5], axis=1),
+    }
+
+
+def run(mesh8, how, topology, w=0.5):
+    agg = comms.make_host_aggregator(mesh8, how=how, topology=topology,
+                                     local_weight=w)
+    out = agg(worker_values())
+    return np.asarray(out["a"]).ravel(), np.asarray(out["b"])
+
+
+class TestAllReduce:
+    def test_equal_is_global_mean(self, mesh8):
+        # ref: all_reduce SUM / world_size (communication.py:21-31)
+        a, b = run(mesh8, "equal", "allreduce")
+        np.testing.assert_allclose(a, np.full(N, 3.5), rtol=1e-6)
+        np.testing.assert_allclose(b[:, 1], np.full(N, 4.0), rtol=1e-6)
+
+    def test_weighted_self_exclusive_peer_mean(self, mesh8):
+        # ref formula (communication.py:7-10): w*own + (1-w)*(sum-own)/(N-1)
+        w = 0.3
+        a, _ = run(mesh8, "weighted", "allreduce", w)
+        own = np.arange(N, dtype=np.float64)
+        expect = w * own + (1 - w) * (own.sum() - own) / (N - 1)
+        np.testing.assert_allclose(a, expect, rtol=1e-6)
+
+
+class TestRing:
+    def test_equal_blends_with_predecessor(self, mesh8):
+        # ref: recv from (rank-1+N)%N, new = (x + r)/2
+        # (Balanced Ring/communication.py:5-30)
+        a, _ = run(mesh8, "equal", "ring")
+        own = np.arange(N, dtype=np.float64)
+        pred = np.roll(own, 1)  # worker i receives worker i-1's value
+        np.testing.assert_allclose(a, (own + pred) / 2, rtol=1e-6)
+
+    def test_weighted(self, mesh8):
+        # ref: w*x + (1-w)*r (Balanced Ring/communication.py:33-62)
+        w = 0.25
+        a, _ = run(mesh8, "weighted", "ring", w)
+        own = np.arange(N, dtype=np.float64)
+        np.testing.assert_allclose(a, w * own + (1 - w) * np.roll(own, 1),
+                                   rtol=1e-6)
+
+
+class TestDoubleRing:
+    def test_equal_three_way_average(self, mesh8):
+        # ref: (x + r1 + r2)/3 (Balanced Double-Ring/communication.py:5-40)
+        a, _ = run(mesh8, "equal", "double_ring")
+        own = np.arange(N, dtype=np.float64)
+        expect = (own + np.roll(own, 1) + np.roll(own, 2)) / 3
+        np.testing.assert_allclose(a, expect, rtol=1e-6)
+
+    def test_weighted(self, mesh8):
+        # ref: w*x + ((1-w)/2)*(r1+r2) (communication.py:43-77)
+        w = 0.6
+        a, _ = run(mesh8, "weighted", "double_ring", w)
+        own = np.arange(N, dtype=np.float64)
+        expect = w * own + ((1 - w) / 2) * (np.roll(own, 1) + np.roll(own, 2))
+        np.testing.assert_allclose(a, expect, rtol=1e-6)
+
+
+class TestProperties:
+    @pytest.mark.parametrize("topology", ["ring", "double_ring"])
+    def test_gossip_converges_to_consensus(self, mesh8, topology):
+        """Repeated gossip averaging drives all workers to the global mean —
+        the asymptotic behavior the reference's local-SGD relies on."""
+        agg = comms.make_host_aggregator(mesh8, how="equal", topology=topology)
+        x = worker_values()
+        for _ in range(60):
+            # block each round: on a 1-core host, pipelined executions of an
+            # 8-thread collective can starve the XLA:CPU rendezvous past its
+            # deadline and abort the process
+            x = jax.block_until_ready(agg(x))
+        a = np.asarray(x["a"]).ravel()
+        # slowest gossip mode decays as cos(pi/8)^rounds ~ 0.924^60 ~ 0.009
+        # of the initial spread (2.29) => ~0.02 residual for ring
+        np.testing.assert_allclose(a, np.full(N, 3.5), atol=0.05)
+        # mean is preserved by equal gossip (float32 accumulation slack)
+        np.testing.assert_allclose(a.mean(), 3.5, rtol=1e-5)
+
+    def test_gossip_preserves_mean_each_round(self, mesh8):
+        agg = comms.make_host_aggregator(mesh8, how="equal", topology="ring")
+        x = agg(worker_values())
+        np.testing.assert_allclose(np.asarray(x["a"]).mean(), 3.5, rtol=1e-6)
+
+    def test_all_modes_compile_and_preserve_structure(self, mesh8):
+        for how in comms.HOWS:
+            for topo in comms.TOPOLOGIES:
+                a, b = run(mesh8, how, topo)
+                assert a.shape == (N,) and b.shape == (N, 2)
+
+    def test_invalid_args_raise(self, mesh8):
+        with pytest.raises(ValueError, match="topology"):
+            comms.make_host_aggregator(mesh8, how="equal", topology="star")(
+                worker_values())
+        with pytest.raises(ValueError, match="how"):
+            comms.make_host_aggregator(mesh8, how="median", topology="ring")(
+                worker_values())
+
+    def test_single_worker_identity(self):
+        """N==1: every mode is the identity (the reference's weighted
+        all-reduce divides by zero here — deliberate fix, SURVEY.md 2.5.10)."""
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu import mesh as M
+        mesh1 = M.build_mesh({"data": 1}, devices=jax.devices()[:1])
+        x = {"a": jnp.ones((1, 3)) * 7}
+        for how in comms.HOWS:
+            for topo in comms.TOPOLOGIES:
+                out = comms.make_host_aggregator(mesh1, how=how, topology=topo)(x)
+                np.testing.assert_allclose(np.asarray(out["a"]), 7.0)

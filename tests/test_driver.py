@@ -1,0 +1,73 @@
+"""End-to-end integration: train_global over the variant matrix on the
+8-worker CPU mesh (SURVEY.md section 4 'Integration')."""
+
+import numpy as np
+import pytest
+
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+
+
+def cfg(**kw):
+    base = dict(model="mlp", dataset="mnist", epochs_global=2, epochs_local=2,
+                batch_size=16, limit_train_samples=800,
+                limit_eval_samples=100, compute_dtype="float32",
+                augment=False, aggregation_by="weights", seed=1)
+    base.update(kw)
+    return Config(**base)
+
+
+def run(mesh8, **kw):
+    return train_global(cfg(**kw), mesh=mesh8, progress=False)
+
+
+class TestEndToEnd:
+    def test_balanced_allreduce_learns(self, mesh8):
+        res = run(mesh8)
+        assert res["global_train_losses"][-1] < res["global_train_losses"][0]
+        assert res["global_val_accuracies"][-1] > 50.0
+        # reference metric structure shapes (trainer.py:192)
+        assert len(res["global_train_losses"]) == 2
+        assert len(res["all_epochs_losses"]) == 4  # epochs_global*epochs_local
+        assert len(res["all_workers_losses"]) == 8
+        assert all(len(w) > 0 for w in res["all_workers_losses"])
+        assert len(res["worker_specific_train_losses"]) == 4
+        assert len(res["global_epoch_accuracies"][0]) == 2
+
+    @pytest.mark.parametrize("topology", ["ring", "double_ring"])
+    def test_gossip_topologies_run(self, mesh8, topology):
+        res = run(mesh8, topology=topology, aggregation_type="weighted")
+        assert res["global_train_losses"][-1] < res["global_train_losses"][0]
+
+    def test_disbalanced_mode(self, mesh8):
+        res = run(mesh8, data_mode="disbalanced", fixed_ratio=0.6)
+        assert np.isfinite(res["global_train_losses"]).all()
+
+    def test_heterogeneous_durations_shift_shards(self, mesh8):
+        # inverse proportionality: 4x-slower worker 0 gets ~4x less data
+        sims = np.array([4.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0])
+        res2 = train_global(cfg(proportionality="inverse"), mesh=mesh8,
+                            simulated_durations=sims, progress=False)
+        w0 = len(res2["all_workers_losses"][0])
+        w1 = len(res2["all_workers_losses"][1])
+        assert w0 < w1  # slower worker saw fewer batches
+
+    def test_reference_direct_proportionality(self, mesh8):
+        # reference-compat mode: slower worker gets MORE data (SURVEY.md 2.5.1)
+        sims = np.array([4.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0])
+        res = train_global(cfg(proportionality="direct"), mesh=mesh8,
+                           simulated_durations=sims, progress=False)
+        w0 = len(res["all_workers_losses"][0])
+        w1 = len(res["all_workers_losses"][1])
+        assert w0 > w1
+
+    def test_time_limit_caps_steps(self, mesh8):
+        # a tiny time budget caps every worker's steps per round
+        sims = np.full(8, 8.0)  # 8s probe for 10 batches -> 0.8 s/batch
+        res = train_global(cfg(time_limit=1.6), mesh=mesh8,
+                           simulated_durations=sims, progress=False)
+        # cap = 1.6/0.8 = 2 batches/worker/epoch -> per local epoch at most
+        # 2*16=32 examples contribute
+        for i in range(8):
+            per_epoch = len(res["all_workers_losses"][i]) / 4  # 4 local epochs
+            assert per_epoch <= 2

@@ -1,0 +1,89 @@
+"""Evaluator metric parity vs sklearn + viz file outputs + CLI smoke."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.eval import _prf, evaluate
+from learning_deep_neural_network_in_distributed_computing_environment_tpu import viz
+
+
+class TestPRF:
+    @pytest.mark.parametrize("average", ["macro", "weighted", "micro"])
+    def test_matches_sklearn(self, average):
+        sklearn = pytest.importorskip("sklearn.metrics")
+        rng = np.random.default_rng(0)
+        labels = rng.integers(0, 10, 500)
+        preds = np.where(rng.random(500) < 0.6, labels,
+                         rng.integers(0, 10, 500))
+        p, r, f = _prf(labels, preds, 10, average)
+        np.testing.assert_allclose(
+            p, sklearn.precision_score(labels, preds, average=average),
+            rtol=1e-9)
+        np.testing.assert_allclose(
+            r, sklearn.recall_score(labels, preds, average=average),
+            rtol=1e-9)
+        np.testing.assert_allclose(
+            f, sklearn.f1_score(labels, preds, average=average), rtol=1e-9)
+
+    def test_missing_class_zero_division(self):
+        # class never predicted: sklearn zero_division=0 semantics
+        labels = np.array([0, 0, 1, 1])
+        preds = np.array([0, 0, 0, 0])
+        p, r, f = _prf(labels, preds, 2, "macro")
+        assert 0 <= p <= 1 and 0 <= f <= 1
+
+
+class TestEvaluate:
+    def test_full_pass_with_tail_padding(self):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import get_model
+        import jax, jax.numpy as jnp
+        model = get_model("mlp", num_classes=10, hidden=8)
+        variables = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)),
+                               train=False)
+        rng = np.random.default_rng(0)
+        images = rng.normal(size=(70, 28, 28, 1)).astype(np.float32)
+        labels = rng.integers(0, 10, 70).astype(np.int32)
+        loss, acc, preds, labs, metrics = evaluate(
+            model, variables, images, labels, batch_size=32, verbose=False)
+        assert len(preds) == 70  # tail batch unpadded
+        assert 0 <= acc <= 100 and np.isfinite(loss)
+        assert set(metrics) >= {"f1_macro", "f1_weighted", "f1_micro"}
+
+
+class TestViz:
+    def test_all_six_files_written(self, tmp_path):
+        out = str(tmp_path / "Graphs")
+        results = {
+            "global_train_losses": [1.0, 0.5],
+            "global_train_accuracies": [50.0, 80.0],
+            "global_val_losses": [1.1, 0.6],
+            "global_val_accuracies": [48.0, 75.0],
+            "worker_specific_train_losses": [1.0, 0.8, 0.6, 0.5],
+            "worker_specific_train_accuracies": [50, 60, 70, 80],
+            "worker_specific_val_losses": [1.1, 0.9, 0.7, 0.6],
+            "worker_specific_val_accuracies": [45, 55, 65, 75],
+            "all_workers_losses": [[1.0, 0.5], [0.9, 0.4]] + [[0.8]] * 6,
+            "all_epochs_losses": [[1.0, 0.9], [0.5, 0.4]],
+            "global_epoch_losses": [[1.0, 0.9, 0.5, 0.4]],
+            "global_epoch_accuracies": [[50.0, 60.0]],
+        }
+        viz.write_all(results, epochs_global=2, epochs_local=2,
+                      output_folder=out)
+        expected = [
+            "loss_distribution_by_worker.png",
+            "loss_distribution_per_epoch.png",
+            "loss_distribution_per_epoch_global.png",
+            "accuracy_distribution_per_epoch_global.png",
+            "training_metrics.png",
+            "training_metrics_0.png",
+        ]
+        for name in expected:  # reference filenames (vizualizator.py)
+            assert os.path.exists(os.path.join(out, name)), name
+
+    def test_empty_worker_losses_do_not_crash(self, tmp_path):
+        viz.plot_loss_distribution_by_worker([[], [1.0]], str(tmp_path))
+        assert os.path.exists(tmp_path / "loss_distribution_by_worker.png")
