@@ -1,0 +1,157 @@
+"""Headline benchmark. Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+
+Round-1 headline: flagship ``EnhancedCNNModel`` (the reference's model,
+44.6M params) CIFAR-10 train-step throughput on one chip, bf16 compute,
+batch 256.  ``vs_baseline`` is measured against the reference
+implementation's own runnable configuration — PyTorch CPU (the reference
+publishes no numbers, BASELINE.md; its ring comms are only correct on CPU,
+SURVEY.md 2.5.2).  The torch-CPU baseline is measured once and cached in
+``.bench_baseline.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+CACHE = os.path.join(REPO, ".bench_baseline.json")
+
+BATCH = 256
+STEPS = 100
+
+
+def measure_tpu_train_step() -> float:
+    """images/sec for the jitted train step (fwd+bwd+Adam) on one chip."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import get_model
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu.train import (
+        softmax_cross_entropy,
+    )
+
+    model = get_model("enhanced_cnn", num_classes=10, dtype=jnp.bfloat16)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(BATCH, 32, 32, 3)).astype(np.float32))
+    y = jnp.asarray(rng.integers(0, 10, BATCH).astype(np.int32))
+
+    variables = jax.jit(lambda k: model.init(k, x[:1], train=False))(
+        jax.random.key(0))
+    tx = optax.adam(1e-3)
+    opt_state = jax.jit(tx.init)(variables["params"])
+
+    @jax.jit
+    def step(params, batch_stats, opt_state, x, y):
+        def loss_fn(p):
+            out, mut = model.apply({"params": p, "batch_stats": batch_stats},
+                                   x, train=True, mutable=["batch_stats"])
+            return softmax_cross_entropy(out, y).mean(), mut["batch_stats"]
+        (loss, bs), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), bs, opt_state, loss
+
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    # warm (compile) and force materialization with a host fetch — on remote
+    # PJRT relays block_until_ready alone does not guarantee execution
+    params, batch_stats, opt_state, loss = step(
+        params, batch_stats, opt_state, x, y)
+    float(loss)
+    # steady-state training pattern: K chained steps, one final fetch.
+    # Each step consumes the previous step's outputs, so the chain cannot
+    # be reordered or cached; the single fetch amortizes relay latency the
+    # same way a real training loop does.  Median of 3 chains damps the
+    # shared-relay run-to-run variance.
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(STEPS):
+            params, batch_stats, opt_state, loss = step(
+                params, batch_stats, opt_state, x, y)
+        float(loss)
+        rates.append(BATCH * STEPS / (time.perf_counter() - t0))
+    rates.sort()
+    return rates[1]
+
+
+def measure_torch_cpu_baseline() -> float:
+    """images/sec for the equivalent torch train step on CPU (cached).
+
+    Architecture matches the reference model (model.py:52-111) so the
+    comparison is the same network on the reference's runnable stack.
+    """
+    if os.path.exists(CACHE):
+        try:
+            with open(CACHE) as f:
+                return json.load(f)["torch_cpu_images_per_sec"]
+        except (json.JSONDecodeError, KeyError, OSError):
+            pass  # corrupt cache: fall through and re-measure
+
+    import torch
+    import torch.nn as nn
+
+    class Block(nn.Module):
+        def __init__(self, cin, cout, stride):
+            super().__init__()
+            self.c1 = nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+            self.b1 = nn.BatchNorm2d(cout)
+            self.c2 = nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+            self.b2 = nn.BatchNorm2d(cout)
+            self.sc = (nn.Sequential(nn.Conv2d(cin, cout, 1, stride, bias=False),
+                                     nn.BatchNorm2d(cout))
+                       if stride != 1 or cin != cout else nn.Identity())
+
+        def forward(self, x):
+            out = torch.relu(self.b1(self.c1(x)))
+            out = self.b2(self.c2(out))
+            return torch.relu(out + self.sc(x))
+
+    layers = [nn.Conv2d(3, 64, 3, 1, 1, bias=False), nn.BatchNorm2d(64),
+              nn.ReLU()]
+    cin = 64
+    for cout in (128, 256, 512, 1024):
+        layers += [Block(cin, cout, 2), Block(cout, cout, 1)]
+        cin = cout
+    model = nn.Sequential(*layers, nn.AdaptiveAvgPool2d(1), nn.Flatten(),
+                          nn.Linear(1024, 10))
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    crit = nn.CrossEntropyLoss()
+    b = 32  # smaller batch: single-core CPU, extrapolated per-image
+    x = torch.randn(b, 3, 32, 32)
+    y = torch.randint(0, 10, (b,))
+    # one warmup + two timed steps
+    for _ in range(1):
+        opt.zero_grad(); crit(model(x), y).backward(); opt.step()
+    t0 = time.perf_counter()
+    for _ in range(2):
+        opt.zero_grad(); crit(model(x), y).backward(); opt.step()
+    ips = b * 2 / (time.perf_counter() - t0)
+    with open(CACHE, "w") as f:
+        json.dump({"torch_cpu_images_per_sec": ips}, f)
+    return ips
+
+
+def main() -> None:
+    ips = measure_tpu_train_step()
+    try:
+        base = measure_torch_cpu_baseline()
+    except Exception as e:  # baseline failure must not kill the benchmark
+        print(f"baseline measurement failed: {e}", file=sys.stderr)
+        base = 0.0
+    vs = ips / base if base > 0 else 1.0
+    print(json.dumps({
+        "metric": "enhanced_cnn_cifar10_train_throughput_1chip",
+        "value": round(ips, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(vs, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

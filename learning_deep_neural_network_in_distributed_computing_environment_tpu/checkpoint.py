@@ -25,7 +25,13 @@ def save_checkpoint(ckpt_dir: str, state, global_epoch: int,
                     keep: int = 3) -> str:
     """Write ``ckpt_<global_epoch>.msgpack``; prune to the newest ``keep``."""
     os.makedirs(ckpt_dir, exist_ok=True)
-    host_state = jax.device_get(state)
+    if jax.process_count() > 1:
+        # sharded leaves span non-addressable devices; gather them to every
+        # host (tiled => concatenated along the worker axis) before saving
+        from jax.experimental import multihost_utils
+        host_state = multihost_utils.process_allgather(state, tiled=True)
+    else:
+        host_state = jax.device_get(state)
     payload = {"state": host_state, "global_epoch": global_epoch}
     path = os.path.join(ckpt_dir, f"ckpt_{global_epoch}.msgpack")
     tmp = path + ".tmp"

@@ -172,6 +172,9 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         caps = [budget_from_time_limit(
             int(np.ceil(len(p) / batch)), float(sec_per_batch[i]),
             cfg.time_limit) for i, p in enumerate(train_parts)]
+        steps_run = np.array([
+            min(int(np.ceil(len(p) / batch)), caps[i])
+            for i, p in enumerate(train_parts)], np.float64)
         t0 = time.perf_counter()
         state, mx = engine.round(
             state, pack_all(trainset, train_parts, caps),
@@ -218,11 +221,12 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                   f"({wall:.1f}s)")
 
         # --- re-partition (trainer.py:179-188) ---------------------------
-        # per-worker round durations: simulated spread if provided, else the
-        # measured wall time (uniform on homogeneous SPMD hardware)
-        round_durations = (np.asarray(simulated_durations, np.float64)
-                           if simulated_durations is not None
-                           else np.full(n, wall))
+        # Per-worker round durations.  A lockstep SPMD round has one wall
+        # clock, so the reference's per-worker epoch wall time is modeled as
+        # (probe sec/batch)_i x (steps run)_i — the same adaptive feedback
+        # signal: at equilibrium all products equalize, i.e. shard sizes
+        # settle inversely proportional to measured speed.
+        round_durations = sec_per_batch * np.maximum(steps_run, 1.0)
         new_ratios = efficiency_ratios(round_durations, cfg.proportionality)
         replace = cfg.data_mode == "disbalanced"
         train_parts = [

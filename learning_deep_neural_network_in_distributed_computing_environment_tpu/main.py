@@ -39,9 +39,10 @@ def main(argv=None) -> int:
         test = results["test"]
         evaluate(results["model"], variables, test.images, test.labels,
                  cfg.batch_size, rank=0)
-        # the six plots (ref main.py:65-77)
-        viz.write_all(results, cfg.epochs_global, cfg.epochs_local,
-                      cfg.out_dir)
+        # the six plots (ref main.py:65-77); use the number of epochs
+        # actually recorded (a resumed run only records the new ones)
+        epochs_run = len(results["global_train_losses"])
+        viz.write_all(results, epochs_run, cfg.epochs_local, cfg.out_dir)
     return 0
 
 

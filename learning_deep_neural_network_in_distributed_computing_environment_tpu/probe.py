@@ -41,6 +41,7 @@ def measure_step_time(model, variables, sample_batch: np.ndarray,
             return out.sum()
         return jax.grad(loss)(params)
 
+    num_batches = max(num_batches, 1)
     params = variables["params"]
     rest = {k: v for k, v in variables.items() if k != "params"}
     fn = jax.jit(fwd_bwd)

@@ -143,11 +143,12 @@ def write_all(results: dict, epochs_global: int, epochs_local: int,
               output_folder="Graphs") -> None:
     """Emit all six reference plots from a train_global results dict
     (ref main.py:65-77, rank-0 only)."""
-    plot_metrics_global(epochs_global, results["global_train_losses"],
+    plot_metrics_global(len(results["global_train_losses"]),
+                        results["global_train_losses"],
                         results["global_train_accuracies"],
                         results["global_val_losses"],
                         results["global_val_accuracies"], output_folder)
-    plot_metrics_total(epochs_global * epochs_local,
+    plot_metrics_total(len(results["worker_specific_train_losses"]),
                        results["worker_specific_train_losses"],
                        results["worker_specific_train_accuracies"],
                        results["worker_specific_val_losses"],
