@@ -117,6 +117,33 @@ def _mnist_synthetic(n_train: int, n_test: int, seed: int):
     return xtr, ytr, xte, yte
 
 
+MASK_TOKEN = 1  # token id 0 is reserved as pad, 1 as [MASK]
+
+
+def _mlm_synthetic(n_train: int, n_test: int, seed: int, seq_len: int = 128,
+                   vocab: int = 1000, mask_rate: float = 0.15):
+    """Learnable masked-LM data: each sequence is an arithmetic token
+    progression ``tok[i] = (base + step*i) % (vocab-2) + 2`` so masked
+    positions are inferable from context; 15% of positions are replaced by
+    [MASK] with the original token as label, all other labels are -1
+    (ignore-index)."""
+    rng = np.random.default_rng(seed)
+
+    def sample(n, rng):
+        base = rng.integers(0, vocab - 2, (n, 1))
+        step = rng.integers(1, 8, (n, 1))
+        pos = np.arange(seq_len)[None, :]
+        toks = ((base + step * pos) % (vocab - 2) + 2).astype(np.int32)
+        masked = rng.random((n, seq_len)) < mask_rate
+        labels = np.where(masked, toks, -1).astype(np.int32)
+        inputs = np.where(masked, MASK_TOKEN, toks).astype(np.int32)
+        return inputs, labels
+
+    xtr, ytr = sample(n_train, rng)
+    xte, yte = sample(n_test, rng)
+    return xtr, ytr, xte, yte, vocab
+
+
 def load_dataset(name: str, data_dir: str = "data", seed: int = 0,
                  limit_train: int = 0, limit_test: int = 0
                  ) -> tuple[Dataset, Dataset]:
@@ -148,6 +175,9 @@ def load_dataset(name: str, data_dir: str = "data", seed: int = 0,
         xte = rng.random((nte, 224, 224, 3), dtype=np.float32)
         yte = rng.integers(0, 1000, nte).astype(np.int32)
         ncls = 1000
+    elif name == "synthetic_mlm":
+        xtr, ytr, xte, yte, ncls = _mlm_synthetic(
+            limit_train or 8192, limit_test or 1024, seed)
     else:
         raise ValueError(f"unknown dataset {name!r}")
 
@@ -156,6 +186,11 @@ def load_dataset(name: str, data_dir: str = "data", seed: int = 0,
     if limit_test:
         xte, yte = xte[:limit_test], yte[:limit_test]
 
+    if np.issubdtype(xtr.dtype, np.integer):
+        # token data: no normalization
+        zero, one = np.zeros(1, np.float32), np.ones(1, np.float32)
+        return (Dataset(xtr, ytr, ncls, zero, one),
+                Dataset(xte, yte, ncls, zero, one))
     mean = xtr.mean(axis=(0, 1, 2))
     std = xtr.std(axis=(0, 1, 2)) + 1e-7
     norm = lambda x: (x - mean) / std

@@ -64,24 +64,30 @@ def evaluate(model, variables, images: np.ndarray, labels: np.ndarray,
         def step(_, inp):
             xb, yb, mb = inp
             out = model.apply(variables, xb, train=False)
-            ce = softmax_cross_entropy(out, yb)
             # reference loss is the mean of per-batch means
             # (evaluator.py:22,33); batches are equal-size here so the
             # example mean is identical up to tail masking
-            return _, (out.argmax(-1), (ce * mb).sum(), ((out.argmax(-1) == yb) * mb).sum())
-        _, (preds, lsums, csums) = jax.lax.scan(step, 0, (x, y, m))
-        return preds, lsums.sum(), csums.sum()
+            ce, w, correct = masked_token_stats(out, yb, mb)
+            return _, (out.argmax(-1), (ce * w).sum(), correct, w.sum())
+        _, (preds, lsums, csums, wsums) = jax.lax.scan(step, 0, (x, y, m))
+        return preds, lsums.sum(), csums.sum(), wsums.sum()
 
-    preds, loss_sum, correct = jax.device_get(run(
+    preds, loss_sum, correct, weight = jax.device_get(run(
         jnp.asarray(x), jnp.asarray(y), jnp.asarray(m)))
-    preds = preds.reshape(-1)[:n]
-    loss = float(loss_sum) / n
-    accuracy = 100.0 * float(correct) / n
+    preds = preds.reshape(-1, *labels.shape[1:])[:n]
+    weight = max(float(weight), 1.0)
+    loss = float(loss_sum) / weight
+    accuracy = 100.0 * float(correct) / weight
 
-    ncls = int(max(labels.max(), preds.max())) + 1
-    pm, rm, fm = _prf(labels, preds, ncls, "macro")
-    pw, rw, fw = _prf(labels, preds, ncls, "weighted")
-    pi, ri, fi = _prf(labels, preds, ncls, "micro")
+    if labels.ndim > 1:  # token task (MLM): score the masked positions
+        valid = labels >= 0
+        labels_flat, preds_flat = labels[valid], preds[valid]
+    else:
+        labels_flat, preds_flat = labels, preds
+    ncls = int(max(labels_flat.max(), preds_flat.max())) + 1
+    pm, rm, fm = _prf(labels_flat, preds_flat, ncls, "macro")
+    pw, rw, fw = _prf(labels_flat, preds_flat, ncls, "weighted")
+    pi, ri, fi = _prf(labels_flat, preds_flat, ncls, "micro")
     metrics = dict(precision_macro=pm, recall_macro=rm, f1_macro=fm,
                    precision_weighted=pw, recall_weighted=rw, f1_weighted=fw,
                    precision_micro=pi, recall_micro=ri, f1_micro=fi)

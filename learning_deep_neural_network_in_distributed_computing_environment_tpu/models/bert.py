@@ -1,0 +1,98 @@
+"""BERT-base masked-LM (BASELINE.md config ladder entry 5).
+
+A from-scratch flax implementation (no ``transformers`` dependency):
+post-LN encoder, learned position embeddings, GELU FFN, untied MLM head.
+Attention is factored through ``ops.attention.dot_product_attention`` so
+the same model runs dense, flash (Pallas), or ring/sequence-parallel
+attention (``parallel/sp.py``) without touching the module.
+
+Defaults are BERT-base: 12 layers, hidden 768, 12 heads, FFN 3072,
+vocab 30522, max position 512.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_init = nn.initializers.normal(stddev=0.02)
+
+
+class SelfAttention(nn.Module):
+    num_heads: int
+    dtype: Any = jnp.float32
+    attention_impl: str = "dense"  # dense | flash | ring (set by parallel/sp)
+    axis_name: Optional[str] = None  # mesh axis for ring attention
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        from ..ops.attention import attend
+        d = x.shape[-1]
+        h = self.num_heads
+        qkv = nn.DenseGeneral((3, h, d // h), kernel_init=_init,
+                              dtype=self.dtype, name="qkv")(x)
+        q, k, v = (qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :])
+        out = attend(q, k, v, mask=mask, impl=self.attention_impl,
+                     axis_name=self.axis_name)
+        return nn.DenseGeneral(d, axis=(-2, -1), kernel_init=_init,
+                               dtype=self.dtype, name="out")(out)
+
+
+class EncoderLayer(nn.Module):
+    num_heads: int
+    ffn_dim: int
+    dtype: Any = jnp.float32
+    attention_impl: str = "dense"
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, mask=None, *, train: bool = False):
+        # post-LN (original BERT): sublayer -> residual -> LayerNorm
+        a = SelfAttention(self.num_heads, dtype=self.dtype,
+                          attention_impl=self.attention_impl,
+                          axis_name=self.axis_name, name="attn")(x, mask)
+        x = nn.LayerNorm(epsilon=1e-12, name="ln_attn")(x + a)
+        f = nn.Dense(self.ffn_dim, kernel_init=_init, dtype=self.dtype,
+                     name="ffn_in")(x)
+        f = nn.gelu(f, approximate=False)
+        f = nn.Dense(x.shape[-1], kernel_init=_init, dtype=self.dtype,
+                     name="ffn_out")(f)
+        return nn.LayerNorm(epsilon=1e-12, name="ln_ffn")(x + f)
+
+
+class BertForMLM(nn.Module):
+    """Token ids [B, L] -> MLM logits [B, L, vocab]."""
+
+    num_classes: int = 30522       # vocab size (engine passes num_classes)
+    num_layers: int = 12
+    hidden: int = 768
+    num_heads: int = 12
+    ffn_dim: int = 3072
+    max_len: int = 512
+    dtype: Any = jnp.float32
+    attention_impl: str = "dense"
+    axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, input_ids, *, train: bool = False):
+        b, l = input_ids.shape
+        tok = nn.Embed(self.num_classes, self.hidden, embedding_init=_init,
+                       name="tok_emb")(input_ids)
+        pos = nn.Embed(self.max_len, self.hidden, embedding_init=_init,
+                       name="pos_emb")(jnp.arange(l)[None, :])
+        x = nn.LayerNorm(epsilon=1e-12, name="ln_emb")(tok + pos)
+        x = jnp.asarray(x, self.dtype)
+        for i in range(self.num_layers):
+            x = EncoderLayer(self.num_heads, self.ffn_dim, dtype=self.dtype,
+                             attention_impl=self.attention_impl,
+                             axis_name=self.axis_name,
+                             name=f"layer{i}")(x, train=train)
+        # untied MLM head: transform + LayerNorm + decode
+        x = jnp.asarray(x, jnp.float32)
+        x = nn.Dense(self.hidden, kernel_init=_init, name="mlm_dense")(x)
+        x = nn.gelu(x, approximate=False)
+        x = nn.LayerNorm(epsilon=1e-12, name="mlm_ln")(x)
+        return nn.Dense(self.num_classes, kernel_init=_init,
+                        name="mlm_decoder")(x)

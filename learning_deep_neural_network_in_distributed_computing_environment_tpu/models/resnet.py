@@ -1,0 +1,108 @@
+"""ResNet-18/50 (BASELINE.md config ladder entries 3 and 4).
+
+Standard He-initialised ResNet v1 in NHWC with a selectable stem:
+``cifar`` (3x3 conv, no max-pool — the right stem for 32x32 inputs, and the
+shape the reference's own model family occupies) or ``imagenet`` (7x7/2 +
+3x3/2 max-pool, for 224x224).  bfloat16 compute, fp32 BN + head.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_he = nn.initializers.he_normal()
+
+
+class BasicBlock(nn.Module):
+    features: int
+    stride: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        norm = lambda name: nn.BatchNorm(use_running_average=not train,
+                                         momentum=0.9, epsilon=1e-5,
+                                         dtype=jnp.float32, name=name)
+        conv = lambda f, k, s, name: nn.Conv(
+            f, (k, k), strides=(s, s), padding=[(k // 2, k // 2)] * 2,
+            use_bias=False, kernel_init=_he, dtype=self.dtype, name=name)
+        out = nn.relu(norm("bn1")(conv(self.features, 3, self.stride,
+                                       "conv1")(x)))
+        out = norm("bn2")(conv(self.features, 3, 1, "conv2")(out))
+        if self.stride != 1 or x.shape[-1] != self.features:
+            x = norm("bn_sc")(conv(self.features, 1, self.stride, "conv_sc")(x))
+        return nn.relu(out + jnp.asarray(x, out.dtype))
+
+
+class Bottleneck(nn.Module):
+    features: int  # bottleneck width; output is 4x
+    stride: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        norm = lambda name: nn.BatchNorm(use_running_average=not train,
+                                         momentum=0.9, epsilon=1e-5,
+                                         dtype=jnp.float32, name=name)
+        conv = lambda f, k, s, name: nn.Conv(
+            f, (k, k), strides=(s, s), padding=[(k // 2, k // 2)] * 2,
+            use_bias=False, kernel_init=_he, dtype=self.dtype, name=name)
+        out = nn.relu(norm("bn1")(conv(self.features, 1, 1, "conv1")(x)))
+        out = nn.relu(norm("bn2")(conv(self.features, 3, self.stride,
+                                       "conv2")(out)))
+        out = norm("bn3")(conv(4 * self.features, 1, 1, "conv3")(out))
+        if self.stride != 1 or x.shape[-1] != 4 * self.features:
+            x = norm("bn_sc")(conv(4 * self.features, 1, self.stride,
+                                   "conv_sc")(x))
+        return nn.relu(out + jnp.asarray(x, out.dtype))
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block: type = BasicBlock
+    num_classes: int = 1000
+    stem: str = "imagenet"  # imagenet | cifar
+    width: int = 64
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = jnp.asarray(x, self.dtype)
+        if self.stem == "imagenet":
+            x = nn.Conv(self.width, (7, 7), strides=(2, 2),
+                        padding=[(3, 3)] * 2, use_bias=False, kernel_init=_he,
+                        dtype=self.dtype, name="stem_conv")(x)
+        else:
+            x = nn.Conv(self.width, (3, 3), padding=[(1, 1)] * 2,
+                        use_bias=False, kernel_init=_he, dtype=self.dtype,
+                        name="stem_conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=jnp.float32, name="stem_bn")(x)
+        x = nn.relu(x)
+        if self.stem == "imagenet":
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1)] * 2)
+        for i, n_blocks in enumerate(self.stage_sizes):
+            for j in range(n_blocks):
+                stride = 2 if i > 0 and j == 0 else 1
+                x = self.block(self.width * 2 ** i, stride=stride,
+                               dtype=self.dtype,
+                               name=f"stage{i + 1}_block{j}")(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, kernel_init=_he, dtype=jnp.float32,
+                     name="fc")(jnp.asarray(x, jnp.float32))
+        return x
+
+
+def ResNet18(num_classes: int = 10, stem: str = "cifar",
+             dtype: Any = jnp.float32, **kw):
+    return ResNet(stage_sizes=(2, 2, 2, 2), block=BasicBlock,
+                  num_classes=num_classes, stem=stem, dtype=dtype, **kw)
+
+
+def ResNet50(num_classes: int = 1000, stem: str = "imagenet",
+             dtype: Any = jnp.float32, **kw):
+    return ResNet(stage_sizes=(3, 4, 6, 3), block=Bottleneck,
+                  num_classes=num_classes, stem=stem, dtype=dtype, **kw)

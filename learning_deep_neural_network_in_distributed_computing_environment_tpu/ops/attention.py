@@ -1,0 +1,58 @@
+"""Attention ops: one entry point, three implementations.
+
+``attend(q, k, v, impl=...)`` with tensors in [batch, seq, heads, head_dim]:
+
+- ``dense``: reference XLA dot-product attention (fp32 softmax);
+- ``flash``: Pallas blockwise-softmax kernel (``ops.pallas_ops``), falling
+  back to dense where Pallas TPU lowering is unavailable;
+- ``ring``:  ring attention over a sequence-sharded mesh axis
+  (``parallel.sp``) — each device holds a sequence block and K/V blocks
+  rotate around the ICI ring with online-softmax accumulation.
+
+The reference has no attention at all (its model is a CNN; SURVEY.md 2.3) —
+this subsystem is the long-context capability required of the framework.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                          mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """[B, Lq, H, D] x [B, Lk, H, D] -> [B, Lq, H, D]; softmax in fp32."""
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) / jnp.sqrt(
+                       jnp.asarray(d, jnp.float32))
+    if mask is not None:
+        s = jnp.where(mask, s, NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v)
+    return out
+
+
+def attend(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+           mask: Optional[jnp.ndarray] = None, impl: str = "dense",
+           axis_name: Optional[str] = None) -> jnp.ndarray:
+    if impl == "dense":
+        return dot_product_attention(q, k, v, mask)
+    if impl == "flash":
+        from .pallas_ops import flash_attention
+        return flash_attention(q, k, v, mask)
+    if impl == "ring":
+        if axis_name is None:
+            raise ValueError("ring attention requires axis_name (the mesh "
+                             "axis the sequence is sharded over)")
+        from ..parallel.sp import ring_attention
+        if mask is not None:
+            raise NotImplementedError(
+                "ring attention currently supports full bidirectional "
+                "attention (mask=None)")
+        return ring_attention(q, k, v, axis_name)
+    raise ValueError(f"unknown attention impl {impl!r}")

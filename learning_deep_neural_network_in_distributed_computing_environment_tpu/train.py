@@ -94,6 +94,20 @@ def softmax_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray):
     return -jnp.take_along_axis(logz, labels[..., None], axis=-1)[..., 0]
 
 
+def masked_token_stats(logits: jnp.ndarray, labels: jnp.ndarray,
+                       batch_mask: jnp.ndarray):
+    """(ce, weight, correct) for classification ([B] labels) and token
+    tasks like MLM ([B, L] labels; positions with label < 0 are ignored,
+    the standard ignore-index convention)."""
+    labels_safe = jnp.maximum(labels, 0)
+    ce = softmax_cross_entropy(logits, labels_safe)
+    w = batch_mask.reshape(
+        batch_mask.shape + (1,) * (labels.ndim - batch_mask.ndim))
+    w = jnp.broadcast_to(w, labels.shape).astype(jnp.float32) * (labels >= 0)
+    correct = ((logits.argmax(-1) == labels) * w).sum()
+    return ce, w, correct
+
+
 def _masked_mean(values: jnp.ndarray, mask: jnp.ndarray):
     return (values * mask).sum() / jnp.maximum(mask.sum(), 1.0)
 
@@ -160,10 +174,9 @@ class LocalSGDEngine:
         out, mut = self.model.apply(
             {"params": params, "batch_stats": batch_stats}, xb, train=True,
             mutable=["batch_stats"])
-        ce = softmax_cross_entropy(out, yb)
-        loss = _masked_mean(ce, mb)
-        correct = ((out.argmax(-1) == yb) * mb).sum()
-        return loss, (mut.get("batch_stats", batch_stats), correct, mb.sum())
+        ce, w, correct = masked_token_stats(out, yb, mb)
+        loss = _masked_mean(ce, w)
+        return loss, (mut.get("batch_stats", batch_stats), correct, w.sum())
 
     def _build_round(self, shapes_key):
         cfg = self.cfg
@@ -205,9 +218,8 @@ class LocalSGDEngine:
                 out = self.model.apply(
                     {"params": params, "batch_stats": batch_stats}, xb,
                     train=False)
-                ce = softmax_cross_entropy(out, yb)
-                return carry, ((ce * mb).sum(), ((out.argmax(-1) == yb) * mb).sum(),
-                               mb.sum())
+                ce, w, correct = masked_token_stats(out, yb, mb)
+                return carry, ((ce * w).sum(), correct, w.sum())
 
             def local_epoch(carry, _):
                 params, batch_stats, opt_state, lr_epoch, rng, _ = carry
