@@ -23,8 +23,15 @@ _CKPT_RE = re.compile(r"ckpt_(\d+)\.msgpack$")
 
 def save_checkpoint(ckpt_dir: str, state, global_epoch: int,
                     keep: int = 3) -> str:
-    """Write ``ckpt_<global_epoch>.msgpack``; prune to the newest ``keep``."""
-    os.makedirs(ckpt_dir, exist_ok=True)
+    """Write ``ckpt_<global_epoch>.msgpack``; prune to the newest ``keep``.
+
+    EVERY process must call this (the multi-host gather below is a
+    collective all hosts must enter).  The gather lands the full state on
+    every host, so every process writes its own copy — per-process tmp
+    name + atomic rename makes this safe on a shared filesystem (identical
+    content, last rename wins) and self-sufficient without one (each host
+    can restore from local disk).
+    """
     if jax.process_count() > 1:
         # sharded leaves span non-addressable devices; gather them to every
         # host (tiled => concatenated along the worker axis) before saving
@@ -32,14 +39,19 @@ def save_checkpoint(ckpt_dir: str, state, global_epoch: int,
         host_state = multihost_utils.process_allgather(state, tiled=True)
     else:
         host_state = jax.device_get(state)
-    payload = {"state": host_state, "global_epoch": global_epoch}
     path = os.path.join(ckpt_dir, f"ckpt_{global_epoch}.msgpack")
-    tmp = path + ".tmp"
+    os.makedirs(ckpt_dir, exist_ok=True)
+    payload = {"state": host_state, "global_epoch": global_epoch}
+    tmp = f"{path}.tmp.{jax.process_index()}"
     with open(tmp, "wb") as f:
         f.write(serialization.to_bytes(payload))
     os.replace(tmp, path)  # atomic publish
-    for old in sorted(_list(ckpt_dir))[:-keep]:
-        os.remove(os.path.join(ckpt_dir, f"ckpt_{old}.msgpack"))
+    if jax.process_index() == 0:
+        for old in _list(ckpt_dir)[:-keep]:
+            try:
+                os.remove(os.path.join(ckpt_dir, f"ckpt_{old}.msgpack"))
+            except FileNotFoundError:
+                pass  # another host pruned first (shared filesystem)
     return path
 
 
@@ -55,18 +67,47 @@ def _list(ckpt_dir: str) -> list[int]:
 
 
 def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
+    """Newest checkpoint path, agreed across hosts.
+
+    Multi-host: every process must call this together.  Restore re-shards
+    with ``jax.device_put`` onto cross-process shardings — a collective all
+    hosts must enter — so the resume decision itself has to be identical
+    everywhere.  Process 0's view of the newest epoch is broadcast; hosts
+    that disagree (e.g. lost local disk) fail loudly instead of hanging.
+    """
     epochs = _list(ckpt_dir)
-    if not epochs:
+    local = max(epochs) if epochs else -1
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        agreed = int(multihost_utils.broadcast_one_to_all(
+            np.int32(local)))
+        if agreed >= 0 and agreed not in epochs:
+            raise FileNotFoundError(
+                f"process {jax.process_index()} is missing checkpoint epoch "
+                f"{agreed} present on process 0 ({ckpt_dir}); cannot resume "
+                "consistently")
+        local = agreed
+    if local < 0:
         return None
-    return os.path.join(ckpt_dir, f"ckpt_{max(epochs)}.msgpack")
+    return os.path.join(ckpt_dir, f"ckpt_{local}.msgpack")
 
 
 def restore_checkpoint(path: str, state_template):
     """Restore (state, global_epoch) from a checkpoint file.  The template
     provides the pytree structure/shapes (e.g. a freshly initialized
-    TrainState)."""
+    TrainState) AND the target shardings: each restored host array is
+    ``device_put`` back onto its template leaf's sharding, so resuming on a
+    (possibly multi-host) mesh re-shards correctly instead of leaving host
+    numpy in the tree."""
     with open(path, "rb") as f:
         data = f.read()
     payload = serialization.from_bytes(
         {"state": state_template, "global_epoch": 0}, data)
-    return payload["state"], int(payload["global_epoch"])
+
+    def _reshard(tmpl, val):
+        if isinstance(tmpl, jax.Array) and hasattr(tmpl, "sharding"):
+            return jax.device_put(val, tmpl.sharding)
+        return val
+
+    state = jax.tree.map(_reshard, state_template, payload["state"])
+    return state, int(payload["global_epoch"])

@@ -250,8 +250,9 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                 for i, p in enumerate(val_parts)]
 
         if (cfg.checkpoint_dir and cfg.checkpoint_every
-                and (global_epoch + 1) % cfg.checkpoint_every == 0
-                and jax.process_index() == 0):
+                and (global_epoch + 1) % cfg.checkpoint_every == 0):
+            # every process enters (the save gathers collectively);
+            # only process 0 writes the file
             ckpt_lib.save_checkpoint(cfg.checkpoint_dir, state,
                                      global_epoch + 1)
 

@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .train import softmax_cross_entropy
+from .train import masked_token_stats
 
 
 def _prf(labels: np.ndarray, preds: np.ndarray, num_classes: int,
