@@ -216,5 +216,7 @@ def pack_shard(images: np.ndarray, labels: np.ndarray, indices: np.ndarray,
         mask = np.concatenate([np.ones(n, np.float32),
                                np.zeros(cap - n, np.float32)])
     x = images[take].reshape(num_steps, batch_size, *images.shape[1:])
-    y = labels[take].reshape(num_steps, batch_size)
+    # labels may be per-example scalars (classification) or per-token
+    # sequences [L] (MLM) — keep any trailing label dims
+    y = labels[take].reshape(num_steps, batch_size, *labels.shape[1:])
     return x, y, mask.reshape(num_steps, batch_size)

@@ -54,14 +54,14 @@ def _round_up(x: int, mult: int) -> int:
     return ((x + mult - 1) // mult) * mult if x else mult
 
 
-def build_model_for(cfg: Config, num_classes: int):
+def build_model_for(cfg: Config, num_classes: int, **extra):
     import jax.numpy as jnp
     dtype = jnp.bfloat16 if cfg.compute_dtype == "bfloat16" else jnp.float32
     if cfg.dtype != "float32":
         raise NotImplementedError(
             "param dtype other than float32 is not supported yet; use "
             "--compute_dtype for bfloat16 activations/matmuls")
-    return get_model(cfg.model, num_classes=num_classes, dtype=dtype)
+    return get_model(cfg.model, num_classes=num_classes, dtype=dtype, **extra)
 
 
 def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
@@ -94,7 +94,24 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
 
     # --- model + engine -------------------------------------------------
     model = build_model_for(cfg, num_classes)
-    engine = LocalSGDEngine(model, mesh, cfg)
+    train_model = None
+    if cfg.sequence_parallel != "none":
+        from .mesh import SEQ_AXIS
+        if SEQ_AXIS not in mesh.shape or mesh.shape[SEQ_AXIS] < 2:
+            raise ValueError(
+                f"--sequence_parallel {cfg.sequence_parallel} needs a "
+                f"'{SEQ_AXIS}' mesh axis of size >= 2 (e.g. --mesh_shape "
+                f"data=2,seq=4); got mesh {dict(mesh.shape)}")
+        if not cfg.model.startswith("bert"):
+            raise ValueError(
+                "--sequence_parallel applies to attention models "
+                f"(bert_*); got --model {cfg.model}")
+        # the round program runs ring / all-to-all attention over the seq
+        # axis; init/probe/final-eval keep the dense twin (same params)
+        train_model = build_model_for(
+            cfg, num_classes, attention_impl=cfg.sequence_parallel,
+            axis_name=SEQ_AXIS)
+    engine = LocalSGDEngine(model, mesh, cfg, train_model=train_model)
     sample = trainset.images[:batch]
     state = engine.init_state(jax.random.key(cfg.seed), sample)
 

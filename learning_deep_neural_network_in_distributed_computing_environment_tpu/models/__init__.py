@@ -34,6 +34,14 @@ def get_model(name: str, **kw: Any):
     if name == "bert_base":
         from .bert import BertForMLM
         return BertForMLM(**kw)
+    if name == "bert_tiny":
+        # CPU-testable MLM model (same code path as bert_base, 2 layers)
+        from .bert import BertForMLM
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("hidden", 64)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("ffn_dim", 128)
+        return BertForMLM(**kw)
     raise ValueError(f"unknown model {name!r}")
 
 
@@ -45,4 +53,5 @@ MODEL_INPUT_SPECS = {
     "resnet18": ((32, 32, 3), 10),
     "resnet50": ((224, 224, 3), 1000),
     "bert_base": ((128,), 30522),
+    "bert_tiny": ((128,), 30522),
 }

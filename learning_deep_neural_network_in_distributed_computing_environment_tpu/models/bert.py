@@ -80,8 +80,14 @@ class BertForMLM(nn.Module):
         b, l = input_ids.shape
         tok = nn.Embed(self.num_classes, self.hidden, embedding_init=_init,
                        name="tok_emb")(input_ids)
+        pos_ids = jnp.arange(l)
+        if self.axis_name is not None:
+            # sequence-parallel: this device holds chunk axis_index of the
+            # sequence, so absolute positions are offset by index * chunk
+            from jax import lax
+            pos_ids = pos_ids + lax.axis_index(self.axis_name) * l
         pos = nn.Embed(self.max_len, self.hidden, embedding_init=_init,
-                       name="pos_emb")(jnp.arange(l)[None, :])
+                       name="pos_emb")(pos_ids[None, :])
         x = nn.LayerNorm(epsilon=1e-12, name="ln_emb")(tok + pos)
         x = jnp.asarray(x, self.dtype)
         for i in range(self.num_layers):

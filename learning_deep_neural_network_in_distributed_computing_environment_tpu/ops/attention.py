@@ -45,14 +45,17 @@ def attend(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if impl == "flash":
         from .pallas_ops import flash_attention
         return flash_attention(q, k, v, mask)
-    if impl == "ring":
+    if impl in ("ring", "all_to_all"):
         if axis_name is None:
-            raise ValueError("ring attention requires axis_name (the mesh "
+            raise ValueError(f"{impl} attention requires axis_name (the mesh "
                              "axis the sequence is sharded over)")
-        from ..parallel.sp import ring_attention
         if mask is not None:
             raise NotImplementedError(
-                "ring attention currently supports full bidirectional "
+                f"{impl} attention currently supports full bidirectional "
                 "attention (mask=None)")
-        return ring_attention(q, k, v, axis_name)
+        if impl == "ring":
+            from ..parallel.sp import ring_attention
+            return ring_attention(q, k, v, axis_name)
+        from ..parallel.sp import ulysses_attention
+        return ulysses_attention(q, k, v, axis_name)
     raise ValueError(f"unknown attention impl {impl!r}")

@@ -38,10 +38,17 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, kb.astype(jnp.float32)) * scale
         return s, vb
 
-    # online-softmax accumulators
-    o = jnp.zeros((b, h, lc, d), jnp.float32)       # weighted-value accum
-    m = jnp.full((b, h, lc), -jnp.inf, jnp.float32)  # running max
-    l = jnp.zeros((b, h, lc), jnp.float32)           # running denominator
+    # online-softmax accumulators.  Under shard_map the scan carry must have
+    # a consistent varying-axes type: the body derives these from q/k (which
+    # vary over the seq axis — and over any other manual axis the caller's
+    # shard_map carries, e.g. data), so the zero initializers must be cast
+    # to q's exact varying-axis set or tracing rejects the carry (found by
+    # running: round-1 shipped this unexecuted and it failed on first use).
+    vma = set(getattr(jax.typeof(qf), "vma", ())) | {axis_name}
+    vary = lambda x: lax.pcast(x, tuple(sorted(vma)), to="varying")
+    o = vary(jnp.zeros((b, h, lc, d), jnp.float32))       # weighted-value accum
+    m = vary(jnp.full((b, h, lc), -jnp.inf, jnp.float32))  # running max
+    l = vary(jnp.zeros((b, h, lc), jnp.float32))           # running denominator
 
     def body(carry, _):
         kb, vb, o, m, l = carry
@@ -61,3 +68,32 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     (kb, vb, o, m, l), _ = lax.scan(body, (k, v, o, m, l), None, length=n)
     out = (o / l[..., None]).astype(q.dtype)         # [B, H, Lc, D]
     return jnp.transpose(out, (0, 2, 1, 3))          # -> [B, Lc, H, D]
+
+
+def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      axis_name: str) -> jnp.ndarray:
+    """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism.
+
+    Two ``lax.all_to_all``s trade the sequence sharding for a head sharding:
+    each device gathers the FULL sequence for ``H/n`` of the heads, runs
+    ordinary dense attention on them, and scatters back to sequence shards.
+    Exact (no online-softmax recurrence); needs ``H % n == 0``; moves 2x the
+    activation bytes of ring attention but in two large dense collectives
+    that XLA overlaps well on ICI.
+    """
+    n = lax.axis_size(axis_name)
+    b, lc, h, d = q.shape
+    if h % n:
+        raise ValueError(
+            f"ulysses attention needs heads ({h}) divisible by the seq-axis "
+            f"size ({n}); use ring attention otherwise")
+    from ..ops.attention import dot_product_attention
+
+    def to_heads(x):   # [B, Lc, H, D] -> [B, L, H/n, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    out = dot_product_attention(to_heads(q), to_heads(k), to_heads(v))
+    # [B, L, H/n, D] -> [B, Lc, H, D]
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                          tiled=True)

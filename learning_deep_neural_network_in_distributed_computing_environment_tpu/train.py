@@ -121,11 +121,19 @@ class LocalSGDEngine:
     """Builds and caches the jitted round program for one (model, mesh,
     config) triple."""
 
-    def __init__(self, model, mesh, cfg: Config):
-        self.model = model
+    def __init__(self, model, mesh, cfg: Config, train_model=None):
+        self.model = model              # dense-attention model: init/probe/eval
+        self.train_model = train_model or model  # round-program model (may use
+        #                                 ring attention over the seq axis;
+        #                                 identical parameter structure)
         self.mesh = mesh
         self.cfg = cfg
         self.n_workers = mesh.shape[DATA_AXIS]
+        from .mesh import SEQ_AXIS
+        self.seq_axis = (
+            SEQ_AXIS if (cfg.sequence_parallel != "none"
+                         and SEQ_AXIS in mesh.shape
+                         and mesh.shape[SEQ_AXIS] > 1) else None)
         # torch.optim.Adam defaults (betas 0.9/0.999, eps 1e-8); LR applied
         # outside so StepLR can drive it per local epoch.
         self.tx = optax.scale_by_adam(b1=0.9, b2=0.999, eps=1e-8)
@@ -171,12 +179,23 @@ class LocalSGDEngine:
     # The round program
     # ------------------------------------------------------------------
     def _loss_and_metrics(self, params, batch_stats, xb, yb, mb):
-        out, mut = self.model.apply(
+        out, mut = self.train_model.apply(
             {"params": params, "batch_stats": batch_stats}, xb, train=True,
             mutable=["batch_stats"])
         ce, w, correct = masked_token_stats(out, yb, mb)
-        loss = _masked_mean(ce, w)
-        return loss, (mut.get("batch_stats", batch_stats), correct, w.sum())
+        if self.seq_axis:
+            # sequence-parallel: this device holds one chunk of every
+            # sequence.  The loss is the GLOBAL masked mean; returning the
+            # local numerator over the global denominator makes
+            # grad(loss_partial), psum'ed over seq, equal grad(global loss).
+            denom = jnp.maximum(lax.psum(w.sum(), self.seq_axis), 1.0)
+            loss = (ce * w).sum() / denom
+            correct = lax.psum(correct, self.seq_axis)
+            total = lax.psum(w.sum(), self.seq_axis)
+        else:
+            loss = _masked_mean(ce, w)
+            total = w.sum()
+        return loss, (mut.get("batch_stats", batch_stats), correct, total)
 
     def _build_round(self, shapes_key):
         cfg = self.cfg
@@ -197,6 +216,11 @@ class LocalSGDEngine:
                 (loss, (new_bs, correct, total)), grads = jax.value_and_grad(
                     self._loss_and_metrics, has_aux=True)(
                         params, batch_stats, xb, yb, mb)
+                if self.seq_axis:
+                    # combine per-chunk grad contributions; params (and the
+                    # Adam update below) stay replicated along seq
+                    grads = lax.psum(grads, self.seq_axis)
+                    loss = lax.psum(loss, self.seq_axis)
                 updates, new_opt = self.tx.update(grads, opt_state, params)
                 new_params = optax.apply_updates(
                     params, jax.tree_util.tree_map(lambda u: -lr * u, updates))
@@ -215,11 +239,14 @@ class LocalSGDEngine:
             def eval_step(carry, inp):
                 params, batch_stats = carry
                 xb, yb, mb = inp
-                out = self.model.apply(
+                out = self.train_model.apply(
                     {"params": params, "batch_stats": batch_stats}, xb,
                     train=False)
                 ce, w, correct = masked_token_stats(out, yb, mb)
-                return carry, ((ce * w).sum(), correct, w.sum())
+                sums = ((ce * w).sum(), correct, w.sum())
+                if self.seq_axis:
+                    sums = lax.psum(sums, self.seq_axis)
+                return carry, sums
 
             def local_epoch(carry, _):
                 params, batch_stats, opt_state, lr_epoch, rng, _ = carry
@@ -299,10 +326,21 @@ class LocalSGDEngine:
             return expand(new_state), expand(metrics)
 
         spec = self._spec
+        in_specs = (spec,) + self._pack_specs(shapes_key) * 2
         fn = jax.shard_map(
             stacked, mesh=self.mesh,
-            in_specs=(spec,) * 7, out_specs=spec)
+            in_specs=in_specs, out_specs=spec)
         return jax.jit(fn, donate_argnums=(0,))
+
+    def _pack_specs(self, shapes_key=None):
+        """(x, y, m) PartitionSpecs for one pack.  Token tasks under
+        sequence parallelism additionally shard the sequence dim of x
+        [N,S,B,L] and y [N,S,B,L] over the seq axis; the per-example mask m
+        [N,S,B] stays data-only."""
+        if self.seq_axis:
+            tok = P(DATA_AXIS, None, None, self.seq_axis)
+            return (tok, tok, self._spec)
+        return (self._spec,) * 3
 
     def round(self, state: TrainState, train_pack, val_pack):
         """Run one global epoch.  Packs are numpy stacks
@@ -313,10 +351,12 @@ class LocalSGDEngine:
         if key not in self._round_cache:
             log.info("compiling round program for shapes %s", key)
             self._round_cache[key] = self._build_round(key)
-        sharding = NamedSharding(self.mesh, self._spec)
-        put = lambda a: jax.device_put(jnp.asarray(a), sharding)
+        xs, ys, ms = self._pack_specs()
+        put = lambda a, s: jax.device_put(
+            jnp.asarray(a), NamedSharding(self.mesh, s))
         new_state, metrics = self._round_cache[key](
-            state, put(x), put(y), put(m), put(xv), put(yv), put(mv))
+            state, put(x, xs), put(y, ys), put(m, ms),
+            put(xv, xs), put(yv, ys), put(mv, ms))
         # block: keeps at most one collective execution in flight (required
         # on 1-core CPU hosts where pipelined rendezvous can deadlock)
         new_state = jax.block_until_ready(new_state)
