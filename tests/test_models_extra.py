@@ -1,0 +1,152 @@
+"""Tests for the round-1-untested layer: ResNet-18/50, BERT, flash
+attention (VERDICT r1 'Next' #5 — no source file with zero test references).
+
+ResNet parameter counts are asserted against the canonical torchvision
+values (resnet18 = 11,689,512; resnet50 = 25,557,032 at 1000 classes,
+imagenet stem), pinning architectural parity for BASELINE ladder entries
+3 and 4.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def _init(model, shape, dtype=jnp.float32):
+    return jax.jit(functools.partial(model.init, train=False))(
+        jax.random.key(0), jnp.zeros(shape, dtype))
+
+
+def _param_count(variables):
+    return int(sum(np.prod(p.shape)
+                   for p in jax.tree.leaves(variables["params"])))
+
+
+class TestResNet:
+    def test_resnet18_imagenet_param_count_matches_torchvision(self):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.models.resnet import ResNet18
+        v = _init(ResNet18(num_classes=1000, stem="imagenet"), (1, 64, 64, 3))
+        assert _param_count(v) == 11_689_512
+
+    def test_resnet50_imagenet_param_count_matches_torchvision(self):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.models.resnet import ResNet50
+        v = _init(ResNet50(num_classes=1000, stem="imagenet"), (1, 64, 64, 3))
+        assert _param_count(v) == 25_557_032
+
+    def test_resnet18_cifar_forward_shape_and_grads(self):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.models.resnet import ResNet18
+        m = ResNet18(num_classes=10, stem="cifar")
+        v = _init(m, (2, 32, 32, 3))
+        assert _param_count(v) == 11_173_962
+
+        @jax.jit
+        def loss_fn(params):
+            out, _ = m.apply({"params": params,
+                              "batch_stats": v["batch_stats"]},
+                             jnp.ones((2, 32, 32, 3)), train=True,
+                             mutable=["batch_stats"])
+            assert out.shape == (2, 10)
+            return (out ** 2).mean()
+
+        grads = jax.grad(loss_fn)(v["params"])
+        assert all(np.isfinite(g).all() for g in jax.tree.leaves(grads))
+
+    def test_resnet50_forward_shape(self):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.models.resnet import ResNet50
+        m = ResNet50(num_classes=1000, stem="imagenet")
+        v = _init(m, (1, 64, 64, 3))
+        out = jax.jit(functools.partial(m.apply, train=False))(
+            v, jnp.ones((1, 64, 64, 3)))
+        assert out.shape == (1, 1000)
+        # imagenet stem: 64x64 -> /4 stem -> /8 stages = 2x2 pre-pool
+        assert np.isfinite(out).all()
+
+
+class TestBert:
+    def _tiny(self, **kw):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import get_model
+        return get_model("bert_tiny", num_classes=1000, **kw)
+
+    def test_forward_shape(self):
+        m = self._tiny()
+        ids = jnp.ones((2, 32), jnp.int32)
+        v = _init(m, (2, 32), jnp.int32)
+        out = jax.jit(functools.partial(m.apply, train=False))(v, ids)
+        assert out.shape == (2, 32, 1000)
+
+    def test_param_count_formula(self):
+        # tok_emb V*H + pos_emb 512*H + ln_emb 2H
+        # + per layer: qkv 3(H*H+H) + out H*H+H + 2 LN 4H + ffn H*F+F+F*H+H
+        # + head: H*H+H + 2H + H*V+V
+        V, H, F, L, P = 1000, 64, 128, 2, 512
+        per_layer = 3 * (H * H + H) + H * H + H + 4 * H + H * F + F + F * H + H
+        expect = (V * H + P * H + 2 * H + L * per_layer
+                  + H * H + H + 2 * H + H * V + V)
+        v = _init(self._tiny(), (2, 32), jnp.int32)
+        assert _param_count(v) == expect
+
+    def test_grads_finite(self):
+        m = self._tiny()
+        v = _init(m, (2, 32), jnp.int32)
+        ids = jnp.ones((2, 32), jnp.int32)
+
+        @jax.jit
+        def loss_fn(params):
+            out = m.apply({"params": params}, ids, train=True)
+            return (out ** 2).mean()
+
+        grads = jax.grad(loss_fn)(v["params"])
+        assert all(np.isfinite(g).all() for g in jax.tree.leaves(grads))
+
+    def test_bert_base_is_base_sized(self):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import get_model
+        m = get_model("bert_base", num_classes=30522)
+        assert (m.num_layers, m.hidden, m.num_heads, m.ffn_dim) == \
+            (12, 768, 12, 3072)
+
+
+class TestFlashAttention:
+    """Pallas flash kernel in interpret mode (CPU) vs the dense reference."""
+
+    def _qkv(self, b=2, l=256, h=2, d=64, seed=0, dtype=jnp.float32):
+        rng = np.random.default_rng(seed)
+        return tuple(jnp.asarray(rng.normal(size=(b, l, h, d)), dtype)
+                     for _ in range(3))
+
+    def test_forward_matches_dense(self):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.ops.pallas_ops import flash_attention
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.ops.attention import dot_product_attention
+        q, k, v = self._qkv()
+        np.testing.assert_allclose(flash_attention(q, k, v),
+                                   dot_product_attention(q, k, v), atol=1e-5)
+
+    def test_backward_matches_dense(self):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.ops.pallas_ops import flash_attention
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.ops.attention import dot_product_attention
+        q, k, v = self._qkv(seed=1)
+        g = jax.grad(lambda *a: (flash_attention(*a) ** 2).sum(),
+                     argnums=(0, 1, 2))(q, k, v)
+        gref = jax.grad(lambda *a: (dot_product_attention(*a) ** 2).sum(),
+                        argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, gref):
+            np.testing.assert_allclose(a, b, atol=1e-4)
+
+    def test_unaligned_shapes_fall_back_to_dense(self):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.ops.pallas_ops import flash_attention
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.ops.attention import dot_product_attention
+        q, k, v = self._qkv(l=100, seed=2)  # 100 % 128 != 0
+        np.testing.assert_allclose(flash_attention(q, k, v),
+                                   dot_product_attention(q, k, v), atol=1e-6)
+
+    def test_attend_dispatch(self):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.ops.attention import attend
+        q, k, v = self._qkv(l=128, seed=3)
+        np.testing.assert_allclose(attend(q, k, v, impl="flash"),
+                                   attend(q, k, v, impl="dense"), atol=1e-5)
+        with pytest.raises(ValueError):
+            attend(q, k, v, impl="nope")
+        with pytest.raises(ValueError):
+            attend(q, k, v, impl="ring")  # no axis_name
