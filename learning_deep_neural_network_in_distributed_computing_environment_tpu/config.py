@@ -78,6 +78,10 @@ class Config:
     mesh_shape: str = "data=-1"   # e.g. "data=8", "data=4,model=2",
     #                               "data=2,model=2,pipe=2"
     sequence_parallel: str = "none"  # none | ring | all_to_all (for bert)
+    # Streamed input pipeline: >0 = feed the round in chunks of this many
+    # steps (host window + async double-buffered transfer) instead of
+    # materializing the whole epoch — required at ImageNet scale.
+    stream_chunk_steps: int = 0
 
     def __post_init__(self) -> None:
         _choices("backend", self.backend, ("jax", "gloo", "nccl", "mpi"))
@@ -180,6 +184,9 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--mesh_shape", type=str, default=d.mesh_shape)
     p.add_argument("--sequence_parallel", type=str, default=d.sequence_parallel,
                    choices=["none", "ring", "all_to_all"])
+    p.add_argument("--stream_chunk_steps", type=int, default=d.stream_chunk_steps,
+                   help="stream the round in chunks of this many steps "
+                        "(0 = materialize the whole epoch)")
     return p
 
 

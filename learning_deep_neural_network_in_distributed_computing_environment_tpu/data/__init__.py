@@ -9,6 +9,7 @@ from .partition import (  # noqa: F401
     efficiency_ratios,
     fixed_classes_for_rank,
     pack_shard,
+    pack_window,
     repartition,
     skew_partition,
     skew_repartition,

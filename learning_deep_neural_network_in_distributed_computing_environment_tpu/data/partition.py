@@ -193,30 +193,41 @@ def budget_from_time_limit(own_batches: int, probe_sec_per_batch: float,
     return min(own_batches, max(cap, 1))
 
 
-def pack_shard(images: np.ndarray, labels: np.ndarray, indices: np.ndarray,
-               batch_size: int, num_steps: int
-               ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Materialize one worker's epoch as fixed-shape arrays.
+def pack_window(images: np.ndarray, labels: np.ndarray, indices: np.ndarray,
+                batch_size: int, start_step: int, num_steps: int
+                ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Materialize steps [start_step, start_step + num_steps) of one
+    worker's epoch as fixed-shape arrays — the unit of the streamed input
+    pipeline (only this window is ever resident on the host).
 
-    Returns (x [num_steps, B, ...], y [num_steps, B], mask [num_steps, B])
-    where mask is 0 for padding examples.  Padding wraps around the worker's
-    own real samples so shapes stay static for jit without skewing BatchNorm
-    batch statistics toward one sample; the mask zeroes loss/metric
-    contributions.
+    Returns (x [num_steps, B, ...], y [num_steps, B, ...], mask
+    [num_steps, B]) where mask is 0 for padding examples.  Padding wraps
+    around the worker's own real samples so shapes stay static for jit
+    without skewing BatchNorm batch statistics toward one sample; the mask
+    zeroes loss/metric contributions.
     """
     idx = np.asarray(indices)
     n = len(idx)
-    cap = num_steps * batch_size
-    if n >= cap:
-        take, mask = idx[:cap], np.ones(cap, np.float32)
+    lo = start_step * batch_size
+    pos = np.arange(lo, lo + num_steps * batch_size)
+    if n == 0:
+        take = np.zeros(len(pos), np.int64)
+        mask = np.zeros(len(pos), np.float32)
     else:
-        pad = (np.zeros(cap - n, np.int64) if n == 0
-               else idx[np.arange(cap - n) % n])
-        take = np.concatenate([idx, pad])
-        mask = np.concatenate([np.ones(n, np.float32),
-                               np.zeros(cap - n, np.float32)])
+        # real sample at positions < n; beyond that, wrap over own samples
+        take = np.where(pos < n, idx[np.minimum(pos, n - 1)],
+                        idx[(pos - n) % n])
+        mask = (pos < n).astype(np.float32)
     x = images[take].reshape(num_steps, batch_size, *images.shape[1:])
     # labels may be per-example scalars (classification) or per-token
     # sequences [L] (MLM) — keep any trailing label dims
     y = labels[take].reshape(num_steps, batch_size, *labels.shape[1:])
     return x, y, mask.reshape(num_steps, batch_size)
+
+
+def pack_shard(images: np.ndarray, labels: np.ndarray, indices: np.ndarray,
+               batch_size: int, num_steps: int
+               ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Materialize one worker's WHOLE epoch (= the window starting at step
+    0); kept for small datasets and the whole-round program."""
+    return pack_window(images, labels, indices, batch_size, 0, num_steps)

@@ -2,9 +2,18 @@
 
 A from-scratch flax implementation (no ``transformers`` dependency):
 post-LN encoder, learned position embeddings, GELU FFN, untied MLM head.
-Attention is factored through ``ops.attention.dot_product_attention`` so
-the same model runs dense, flash (Pallas), or ring/sequence-parallel
-attention (``parallel/sp.py``) without touching the module.
+Attention is factored through ``ops.attention.attend`` so the same model
+runs dense, flash (Pallas), or ring/all-to-all sequence-parallel attention
+(``parallel/sp.py``) without touching the module.
+
+Tensor parallelism (``parallel/tp.py``, Megatron construction): with
+``tp_size > 1`` the module computes its LOCAL shard — ``num_heads/tp``
+attention heads and ``ffn_dim/tp`` hidden units — and the row-parallel
+output projections carry explicit biases added AFTER the cross-shard
+reduction.  The dense module (``tp_size=1``) has the identical parameter
+STRUCTURE, so a TP mesh run and a dense run share checkpoints: the global
+parameter arrays are simply sharded over the ``model`` axis
+(``tp_param_specs``).
 
 Defaults are BERT-base: 12 layers, hidden 768, 12 heads, FFN 3072,
 vocab 30522, max position 512.
@@ -15,50 +24,68 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
+
+from ..parallel.tp import copy_to_tp_region, reduce_from_tp_region
 
 _init = nn.initializers.normal(stddev=0.02)
 
 
 class SelfAttention(nn.Module):
-    num_heads: int
+    num_heads: int                 # GLOBAL head count
     dtype: Any = jnp.float32
-    attention_impl: str = "dense"  # dense | flash | ring (set by parallel/sp)
-    axis_name: Optional[str] = None  # mesh axis for ring attention
+    attention_impl: str = "dense"  # dense | flash | ring | all_to_all
+    axis_name: Optional[str] = None   # mesh axis for seq-parallel attention
+    tp_size: int = 1
+    model_axis: Optional[str] = None  # mesh axis for tensor parallelism
 
     @nn.compact
     def __call__(self, x, mask=None):
         from ..ops.attention import attend
         d = x.shape[-1]
-        h = self.num_heads
-        qkv = nn.DenseGeneral((3, h, d // h), kernel_init=_init,
-                              dtype=self.dtype, name="qkv")(x)
+        head_dim = d // self.num_heads
+        h_local = self.num_heads // self.tp_size
+        x_in = copy_to_tp_region(x, self.model_axis)
+        qkv = nn.DenseGeneral((3, h_local, head_dim), kernel_init=_init,
+                              dtype=self.dtype, name="qkv")(x_in)
         q, k, v = (qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :])
         out = attend(q, k, v, mask=mask, impl=self.attention_impl,
                      axis_name=self.axis_name)
-        return nn.DenseGeneral(d, axis=(-2, -1), kernel_init=_init,
-                               dtype=self.dtype, name="out")(out)
+        y = nn.DenseGeneral(d, axis=(-2, -1), kernel_init=_init,
+                            use_bias=False, dtype=self.dtype,
+                            name="out")(out)
+        y = reduce_from_tp_region(y, self.model_axis)
+        return y + self.param("out_bias", nn.initializers.zeros,
+                              (d,)).astype(y.dtype)
 
 
 class EncoderLayer(nn.Module):
     num_heads: int
-    ffn_dim: int
+    ffn_dim: int                   # GLOBAL FFN width
     dtype: Any = jnp.float32
     attention_impl: str = "dense"
     axis_name: Optional[str] = None
+    tp_size: int = 1
+    model_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, x, mask=None, *, train: bool = False):
         # post-LN (original BERT): sublayer -> residual -> LayerNorm
         a = SelfAttention(self.num_heads, dtype=self.dtype,
                           attention_impl=self.attention_impl,
-                          axis_name=self.axis_name, name="attn")(x, mask)
+                          axis_name=self.axis_name, tp_size=self.tp_size,
+                          model_axis=self.model_axis, name="attn")(x, mask)
         x = nn.LayerNorm(epsilon=1e-12, name="ln_attn")(x + a)
-        f = nn.Dense(self.ffn_dim, kernel_init=_init, dtype=self.dtype,
-                     name="ffn_in")(x)
+        f_in = copy_to_tp_region(x, self.model_axis)
+        f = nn.Dense(self.ffn_dim // self.tp_size, kernel_init=_init,
+                     dtype=self.dtype, name="ffn_in")(f_in)
         f = nn.gelu(f, approximate=False)
-        f = nn.Dense(x.shape[-1], kernel_init=_init, dtype=self.dtype,
-                     name="ffn_out")(f)
+        f = nn.Dense(x.shape[-1], kernel_init=_init, use_bias=False,
+                     dtype=self.dtype, name="ffn_out")(f)
+        f = reduce_from_tp_region(f, self.model_axis)
+        f = f + self.param("ffn_bias", nn.initializers.zeros,
+                           (x.shape[-1],)).astype(f.dtype)
         return nn.LayerNorm(epsilon=1e-12, name="ln_ffn")(x + f)
 
 
@@ -74,6 +101,8 @@ class BertForMLM(nn.Module):
     dtype: Any = jnp.float32
     attention_impl: str = "dense"
     axis_name: Optional[str] = None
+    tp_size: int = 1
+    model_axis: Optional[str] = None
 
     @nn.compact
     def __call__(self, input_ids, *, train: bool = False):
@@ -93,12 +122,41 @@ class BertForMLM(nn.Module):
         for i in range(self.num_layers):
             x = EncoderLayer(self.num_heads, self.ffn_dim, dtype=self.dtype,
                              attention_impl=self.attention_impl,
-                             axis_name=self.axis_name,
+                             axis_name=self.axis_name, tp_size=self.tp_size,
+                             model_axis=self.model_axis,
                              name=f"layer{i}")(x, train=train)
-        # untied MLM head: transform + LayerNorm + decode
+        # untied MLM head: transform + LayerNorm + decode (replicated along
+        # the model axis; vocab-parallel decode is a later optimization)
         x = jnp.asarray(x, jnp.float32)
         x = nn.Dense(self.hidden, kernel_init=_init, name="mlm_dense")(x)
         x = nn.gelu(x, approximate=False)
         x = nn.LayerNorm(epsilon=1e-12, name="mlm_ln")(x)
         return nn.Dense(self.num_classes, kernel_init=_init,
                         name="mlm_decoder")(x)
+
+
+def tp_param_specs(params, axis: str = "model"):
+    """PartitionSpec tree sharding BERT parameters over the TP ``axis``
+    (no worker axis — the engine prepends it).
+
+    qkv kernel [H, 3, heads, hd] / bias [3, heads, hd]: heads dim sharded;
+    attn out kernel [heads, hd, H] and ffn_out kernel [F, H]: dim 0 sharded
+    (row-parallel); ffn_in kernel [H, F] / bias [F]: F sharded (column-
+    parallel); everything else (embeddings, LNs, post-reduce biases, MLM
+    head) replicated.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    def spec(path, leaf):
+        names = [getattr(p, "key", str(p)) for p in path]
+        if "qkv" in names:
+            return P(None, None, axis, None) if leaf.ndim == 4 \
+                else P(None, axis, None)
+        if "out" in names:               # kernel [heads, hd, H]
+            return P(axis, None, None)
+        if "ffn_in" in names:
+            return P(None, axis) if leaf.ndim == 2 else P(axis)
+        if "ffn_out" in names:           # kernel [F, H]
+            return P(axis, None)
+        return P()
+    return jax.tree_util.tree_map_with_path(spec, params)

@@ -121,10 +121,12 @@ class LocalSGDEngine:
     """Builds and caches the jitted round program for one (model, mesh,
     config) triple."""
 
-    def __init__(self, model, mesh, cfg: Config, train_model=None):
+    def __init__(self, model, mesh, cfg: Config, train_model=None,
+                 param_specs_fn=None):
         self.model = model              # dense-attention model: init/probe/eval
         self.train_model = train_model or model  # round-program model (may use
-        #                                 ring attention over the seq axis;
+        #                                 ring attention over the seq axis
+        #                                 and/or tensor-parallel shards;
         #                                 identical parameter structure)
         self.mesh = mesh
         self.cfg = cfg
@@ -134,6 +136,11 @@ class LocalSGDEngine:
             SEQ_AXIS if (cfg.sequence_parallel != "none"
                          and SEQ_AXIS in mesh.shape
                          and mesh.shape[SEQ_AXIS] > 1) else None)
+        # tensor parallelism: params(single-replica) -> PartitionSpec tree
+        # over the 'model' axis (e.g. models.bert.tp_param_specs)
+        self.param_specs_fn = param_specs_fn
+        self.param_specs = None      # set by init_state
+        self._sspec = None           # full TrainState spec tree (TP only)
         # torch.optim.Adam defaults (betas 0.9/0.999, eps 1e-8); LR applied
         # outside so StepLR can drive it per local epoch.
         self.tx = optax.scale_by_adam(b1=0.9, b2=0.999, eps=1e-8)
@@ -171,9 +178,50 @@ class LocalSGDEngine:
                 jax.random.fold_in(jax.random.key(self.cfg.seed), i)))(
                     jnp.arange(n)),
         )
+        if self.param_specs_fn is not None:
+            self.param_specs = self.param_specs_fn(params)
+            self._sspec = self._build_state_specs(state)
+            return jax.tree_util.tree_map(
+                lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+                state, self._sspec)
         sharding = NamedSharding(self.mesh, self._spec)
         return jax.tree_util.tree_map(
             lambda x: jax.device_put(x, sharding), state)
+
+    def _build_state_specs(self, state: TrainState):
+        """Full-structure PartitionSpec tree for a worker-stacked
+        TrainState under tensor parallelism: every leaf is sharded over
+        ``data`` on the worker axis, and param-shaped leaves (params and
+        the Adam moments that mirror them) additionally over ``model`` per
+        ``self.param_specs``."""
+        pfull = jax.tree_util.tree_map(
+            lambda s: P(DATA_AXIS, *s), self.param_specs)
+        dspec = lambda t: jax.tree_util.tree_map(lambda _: self._spec, t)
+
+        def opt_specs(opt_state):
+            # optax states are pytrees of namedtuples; map by structure:
+            # any sub-tree with the params' treedef (the Adam moments)
+            # gets the param specs, everything else is data-only
+            pdef = jax.tree_util.tree_structure(state.params)
+            def rec(node):
+                try:
+                    if jax.tree_util.tree_structure(node) == pdef:
+                        return pfull
+                except Exception:
+                    pass
+                if isinstance(node, tuple) and hasattr(node, "_fields"):
+                    return type(node)(*(rec(c) for c in node))
+                if isinstance(node, (list, tuple)):
+                    return type(node)(rec(c) for c in node)
+                if isinstance(node, dict):
+                    return {k: rec(v) for k, v in node.items()}
+                return self._spec
+            return rec(opt_state)
+
+        return TrainState(
+            params=pfull, batch_stats=dspec(state.batch_stats),
+            opt_state=opt_specs(state.opt_state),
+            lr_epoch=self._spec, rng=self._spec)
 
     # ------------------------------------------------------------------
     # The round program
@@ -197,56 +245,64 @@ class LocalSGDEngine:
             total = w.sum()
         return loss, (mut.get("batch_stats", batch_stats), correct, total)
 
+    def _make_step_fns(self, augment: bool):
+        """The shared per-batch bodies: one SGD step and one eval step.
+        Used by both the whole-round program and the streamed chunk
+        programs, so their numerics are identical by construction."""
+
+        def train_step(carry, inp):
+            params, batch_stats, opt_state, rng, lr = carry[:5]
+            xb, yb, mb = inp
+            rng, k = jax.random.split(jax.random.wrap_key_data(rng))
+            rng = jax.random.key_data(rng)
+            if augment:
+                xb = augment_batch(k, xb)
+            (loss, (new_bs, correct, total)), grads = jax.value_and_grad(
+                self._loss_and_metrics, has_aux=True)(
+                    params, batch_stats, xb, yb, mb)
+            if self.seq_axis:
+                # combine per-chunk grad contributions; params (and the
+                # Adam update below) stay replicated along seq
+                grads = lax.psum(grads, self.seq_axis)
+                loss = lax.psum(loss, self.seq_axis)
+            updates, new_opt = self.tx.update(grads, opt_state, params)
+            new_params = optax.apply_updates(
+                params, jax.tree_util.tree_map(lambda u: -lr * u, updates))
+            # fully-masked (padding) steps leave everything untouched —
+            # including the carried last-real-batch grads, so gradients
+            # mode aggregates each worker's stale last REAL gradient
+            # (reference semantics) rather than a padding step's zeros
+            do = total > 0
+            params = _tree_where(do, new_params, params)
+            batch_stats = _tree_where(do, new_bs, batch_stats)
+            opt_state = _tree_where(do, new_opt, opt_state)
+            grads = _tree_where(do, grads, carry[5])
+            return ((params, batch_stats, opt_state, rng, lr, grads),
+                    (loss, correct, total))
+
+        def eval_step(carry, inp):
+            params, batch_stats = carry
+            xb, yb, mb = inp
+            out = self.train_model.apply(
+                {"params": params, "batch_stats": batch_stats}, xb,
+                train=False)
+            ce, w, correct = masked_token_stats(out, yb, mb)
+            sums = ((ce * w).sum(), correct, w.sum())
+            if self.seq_axis:
+                sums = lax.psum(sums, self.seq_axis)
+            return carry, sums
+
+        return train_step, eval_step
+
     def _build_round(self, shapes_key):
         cfg = self.cfg
         epochs_local = cfg.epochs_local
         augment = cfg.augment and len(shapes_key[0]) == 5  # [S,B,H,W,C]
+        train_step, eval_step = self._make_step_fns(augment)
 
         def per_worker(state: TrainState, x, y, m, xv, yv, mv):
             """One worker's round.  x:[S,B,...] y,m:[S,B]; val likewise."""
             zero_grads = jax.tree_util.tree_map(jnp.zeros_like, state.params)
-
-            def train_step(carry, inp):
-                params, batch_stats, opt_state, rng, lr = carry[:5]
-                xb, yb, mb = inp
-                rng, k = jax.random.split(jax.random.wrap_key_data(rng))
-                rng = jax.random.key_data(rng)
-                if augment:
-                    xb = augment_batch(k, xb)
-                (loss, (new_bs, correct, total)), grads = jax.value_and_grad(
-                    self._loss_and_metrics, has_aux=True)(
-                        params, batch_stats, xb, yb, mb)
-                if self.seq_axis:
-                    # combine per-chunk grad contributions; params (and the
-                    # Adam update below) stay replicated along seq
-                    grads = lax.psum(grads, self.seq_axis)
-                    loss = lax.psum(loss, self.seq_axis)
-                updates, new_opt = self.tx.update(grads, opt_state, params)
-                new_params = optax.apply_updates(
-                    params, jax.tree_util.tree_map(lambda u: -lr * u, updates))
-                # fully-masked (padding) steps leave everything untouched —
-                # including the carried last-real-batch grads, so gradients
-                # mode aggregates each worker's stale last REAL gradient
-                # (reference semantics) rather than a padding step's zeros
-                do = total > 0
-                params = _tree_where(do, new_params, params)
-                batch_stats = _tree_where(do, new_bs, batch_stats)
-                opt_state = _tree_where(do, new_opt, opt_state)
-                grads = _tree_where(do, grads, carry[5])
-                return ((params, batch_stats, opt_state, rng, lr, grads),
-                        (loss, correct, total))
-
-            def eval_step(carry, inp):
-                params, batch_stats = carry
-                xb, yb, mb = inp
-                out = self.train_model.apply(
-                    {"params": params, "batch_stats": batch_stats}, xb,
-                    train=False)
-                ce, w, correct = masked_token_stats(out, yb, mb)
-                sums = ((ce * w).sum(), correct, w.sum())
-                if self.seq_axis:
-                    sums = lax.psum(sums, self.seq_axis)
-                return carry, sums
 
             def local_epoch(carry, _):
                 params, batch_stats, opt_state, lr_epoch, rng, _ = carry
@@ -325,11 +381,11 @@ class LocalSGDEngine:
                 squeeze(state), *map(lambda a: a[0], (x, y, m, xv, yv, mv)))
             return expand(new_state), expand(metrics)
 
-        spec = self._spec
-        in_specs = (spec,) + self._pack_specs(shapes_key) * 2
+        sspec = self._sspec if self._sspec is not None else self._spec
+        in_specs = (sspec,) + self._pack_specs(shapes_key) * 2
         fn = jax.shard_map(
             stacked, mesh=self.mesh,
-            in_specs=in_specs, out_specs=spec)
+            in_specs=in_specs, out_specs=(sspec, self._spec))
         return jax.jit(fn, donate_argnums=(0,))
 
     def _pack_specs(self, shapes_key=None):
@@ -341,6 +397,14 @@ class LocalSGDEngine:
             tok = P(DATA_AXIS, None, None, self.seq_axis)
             return (tok, tok, self._spec)
         return (self._spec,) * 3
+
+    def _inner_specs(self):
+        """Spec for the streamed-round inner carry
+        (params, batch_stats, opt_state, rng, grads)."""
+        if self._sspec is None:
+            return self._spec
+        return (self._sspec.params, self._sspec.batch_stats,
+                self._sspec.opt_state, self._spec, self._sspec.params)
 
     def round(self, state: TrainState, train_pack, val_pack):
         """Run one global epoch.  Packs are numpy stacks
@@ -361,3 +425,182 @@ class LocalSGDEngine:
         # on 1-core CPU hosts where pipelined rendezvous can deadlock)
         new_state = jax.block_until_ready(new_state)
         return new_state, jax.device_get(metrics)
+
+    # ------------------------------------------------------------------
+    # Streamed rounds: per-chunk host->device feeding (ImageNet scale)
+    # ------------------------------------------------------------------
+    # The whole-round program holds the full epoch in device memory — fine
+    # for CIFAR, impossible for ImageNet (8 workers x real epoch ~ hundreds
+    # of GB).  The streamed path runs the SAME step bodies
+    # (``_make_step_fns``) chunk by chunk: the host feeds fixed-shape
+    # [N, C, B, ...] windows, dispatch is async (chunk k+1 transfers while
+    # chunk k executes — double buffering for free), and only O(metrics)
+    # bytes ever return to the host.
+
+    def _wrap_stacked(self, per_worker, in_specs, out_specs=None,
+                      donate=False):
+        """shard_map a per-worker fn over the worker-stacked leading axis."""
+
+        def stacked(*args):
+            sq = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
+            ex = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
+            unstacked = [a if s == P() else sq(a)
+                         for a, s in zip(args, in_specs)]
+            return ex(per_worker(*unstacked))
+
+        fn = jax.shard_map(stacked, mesh=self.mesh, in_specs=tuple(in_specs),
+                           out_specs=out_specs or self._spec)
+        return jax.jit(fn, donate_argnums=(0,) if donate else ())
+
+    def _build_chunk_train(self, shapes_key):
+        augment = self.cfg.augment and len(shapes_key) == 5  # [C,B,H,W,Ch]
+        train_step, _ = self._make_step_fns(augment)
+
+        def per_worker(inner, lr, x, y, m):
+            params, batch_stats, opt_state, rng, grads = inner
+            carry = (params, batch_stats, opt_state, rng, lr, grads)
+            carry, ys = lax.scan(train_step, carry, (x, y, m))
+            params, batch_stats, opt_state, rng, _, grads = carry
+            return (params, batch_stats, opt_state, rng, grads), ys
+
+        xs, ys_, ms = self._pack_specs()
+        inner = self._inner_specs()
+        return self._wrap_stacked(
+            per_worker, [inner, P(), xs, ys_, ms],
+            out_specs=(inner, self._spec), donate=True)
+
+    def _build_chunk_eval(self, shapes_key):
+        _, eval_step = self._make_step_fns(False)
+
+        def per_worker(params, batch_stats, x, y, m):
+            _, sums = lax.scan(eval_step, (params, batch_stats), (x, y, m))
+            return sums  # (ce_sum, correct, w_sum), each [C]
+
+        xs, ys_, ms = self._pack_specs()
+        pspec = self._sspec.params if self._sspec is not None else self._spec
+        bspec = self._sspec.batch_stats if self._sspec is not None \
+            else self._spec
+        return self._wrap_stacked(
+            per_worker, [pspec, bspec, xs, ys_, ms],
+            out_specs=self._spec)
+
+    def _build_sync(self):
+        cfg = self.cfg
+
+        def per_worker(params, grads):
+            agg_grad_norm = jnp.zeros(())
+            if cfg.aggregation_by == "weights":
+                params = comms.aggregate(
+                    params, how=cfg.aggregation_type, topology=cfg.topology,
+                    local_weight=cfg.local_weight)
+            else:
+                agg = comms.aggregate(
+                    grads, how=cfg.aggregation_type, topology=cfg.topology,
+                    local_weight=cfg.local_weight)
+                agg_grad_norm = optax.global_norm(agg)
+            return params, agg_grad_norm
+
+        pspec = self._sspec.params if self._sspec is not None else self._spec
+        return self._wrap_stacked(per_worker, [pspec, pspec],
+                                  out_specs=(pspec, self._spec))
+
+    def round_streamed(self, state: TrainState, train_chunks, val_chunks):
+        """One global epoch with streamed input.
+
+        ``train_chunks(epoch)`` / ``val_chunks(epoch)`` return an iterator
+        of fixed-shape numpy (x [N,C,B,...], y [N,C,B,...], m [N,C,B])
+        chunks for that local epoch.  Returns (new_state, mx) with the same
+        metric structure as ``round`` — numerics match the whole-round
+        program exactly (same step bodies, same RNG stream).
+        """
+        cfg = self.cfg
+        n = self.n_workers
+        xs_spec, ys_spec, ms_spec = self._pack_specs()
+        put = lambda a, s: jax.device_put(
+            jnp.asarray(a), NamedSharding(self.mesh, s))
+        zeros_like = jax.jit(
+            lambda p: jax.tree_util.tree_map(jnp.zeros_like, p))
+
+        inner = (state.params, state.batch_stats, state.opt_state, state.rng,
+                 zeros_like(state.params))
+        epoch0 = int(jax.device_get(state.lr_epoch)[0])
+
+        per_epoch = []  # (train_chunk_ys, val_chunk_sums) device arrays
+        for e in range(cfg.epochs_local):
+            lr = jnp.asarray(
+                steplr(cfg.lr, cfg.lr_gamma, cfg.lr_step_size, epoch0 + e),
+                jnp.float32)
+            # fresh zero grads each epoch: the round program resets the
+            # last-grad carry per local epoch (scan init), match it
+            if e > 0:
+                inner = inner[:4] + (zeros_like(inner[0]),)
+            t_ys = []
+            for (x, y, m) in train_chunks(e):
+                key = ("ct", tuple(x.shape[1:]))
+                if key not in self._round_cache:
+                    log.info("compiling chunk-train program for %s", key)
+                    self._round_cache[key] = self._build_chunk_train(
+                        tuple(x.shape[1:]))
+                inner, ys = self._round_cache[key](
+                    inner, lr, put(x, xs_spec), put(y, ys_spec),
+                    put(m, ms_spec))
+                t_ys.append(ys)
+            v_sums = []
+            for (x, y, m) in val_chunks(e):
+                key = ("ce", tuple(x.shape[1:]))
+                if key not in self._round_cache:
+                    log.info("compiling chunk-eval program for %s", key)
+                    self._round_cache[key] = self._build_chunk_eval(
+                        tuple(x.shape[1:]))
+                v_sums.append(self._round_cache[key](
+                    inner[0], inner[1], put(x, xs_spec), put(y, ys_spec),
+                    put(m, ms_spec)))
+            # one fetch barrier per epoch keeps at most one epoch's worth of
+            # dispatch in flight (see the 1-core-CPU rendezvous note above)
+            jax.block_until_ready(inner[0])
+            per_epoch.append((t_ys, v_sums))
+
+        params, batch_stats, opt_state, rng, last_grads = inner
+        if "sync" not in self._round_cache:
+            self._round_cache["sync"] = self._build_sync()
+        params, agg_grad_norm = self._round_cache["sync"](params, last_grads)
+        params = jax.block_until_ready(params)
+
+        new_state = TrainState(
+            params=params, batch_stats=batch_stats, opt_state=opt_state,
+            lr_epoch=state.lr_epoch + cfg.epochs_local, rng=rng)
+
+        # --- host metric assembly (same structure as `round`) -------------
+        E = cfg.epochs_local
+        losses, corrects, totals, vls, vcs, vws = ([] for _ in range(6))
+        for t_ys, v_sums in per_epoch:
+            l, c, t = zip(*(jax.device_get(ys) for ys in t_ys))
+            losses.append(np.concatenate(l, 1))     # [N, S]
+            corrects.append(np.concatenate(c, 1))
+            totals.append(np.concatenate(t, 1))
+            vl, vc, vw = zip(*(jax.device_get(s) for s in v_sums))
+            vls.append(np.concatenate(vl, 1).sum(1))  # [N]
+            vcs.append(np.concatenate(vc, 1).sum(1))
+            vws.append(np.concatenate(vw, 1).sum(1))
+        losses = np.stack(losses, 1)                 # [N, E, S]
+        totals = np.stack(totals, 1)
+        corrects = np.stack(corrects, 1)
+        real = (totals > 0).astype(np.float32)
+        train_loss = (losses * real).sum(-1) / np.maximum(real.sum(-1), 1.0)
+        train_acc = 100.0 * corrects.sum(-1) / np.maximum(totals.sum(-1), 1.0)
+        vw_arr = np.maximum(np.stack(vws, 1), 1.0)   # [N, E]
+        val_loss = np.stack(vls, 1) / vw_arr
+        val_acc = 100.0 * np.stack(vcs, 1) / vw_arr
+        tile = lambda v: np.broadcast_to(np.asarray(v, np.float32), (n,))
+        mx = dict(
+            batch_losses=losses, batch_mask=real,
+            train_loss=train_loss, train_acc=train_acc,
+            val_loss=val_loss, val_acc=val_acc,
+            avg_acc=np.broadcast_to(train_acc.mean(0), (n, E)),
+            agg_grad_norm=jax.device_get(agg_grad_norm),
+            global_train_loss=tile(train_loss.mean()),
+            global_train_acc=tile(train_acc.mean()),
+            global_val_loss=tile(val_loss.mean()),
+            global_val_acc=tile(val_acc.mean()),
+        )
+        return new_state, mx

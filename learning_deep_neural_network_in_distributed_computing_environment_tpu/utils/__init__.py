@@ -1,0 +1,5 @@
+"""Framework utilities: FLOPs accounting + MFU measurement."""
+
+from .flops import compiled_flops, mfu, peak_flops
+
+__all__ = ["compiled_flops", "mfu", "peak_flops"]

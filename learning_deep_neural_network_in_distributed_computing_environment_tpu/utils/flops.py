@@ -1,0 +1,65 @@
+"""FLOPs accounting + MFU (model FLOPs utilization).
+
+The reference publishes no performance numbers at all (BASELINE.md), so the
+measurement harness is designed from scratch: per-step FLOPs come from XLA's
+own cost model on the exact compiled executable (``compiled.cost_analysis()``
+— counts every fused matmul/conv at 2*M*N*K, which is more faithful than
+hand formulas), and MFU divides the achieved FLOP rate by the chip's peak
+bf16 rate.  BASELINE.json's north star is >= 50% MFU for ResNet-50/ImageNet.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+# Peak dense bf16 FLOP/s per chip by device kind (public spec sheets).
+# Matched by substring, most specific first.
+_PEAK_BF16 = (
+    ("v6", 918e12),        # Trillium / v6e
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),   # v5e reports as "TPU v5 lite"
+    ("v5e", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def peak_flops(device: Optional[jax.Device] = None) -> Optional[float]:
+    """Peak bf16 FLOP/s of one chip, or None when unknown (e.g. CPU)."""
+    d = device or jax.devices()[0]
+    if d.platform != "tpu":
+        return None
+    kind = d.device_kind.lower()
+    for key, peak in _PEAK_BF16:
+        if key in kind:
+            return peak
+    return None
+
+
+def compiled_flops(jitted, *args, **kwargs) -> Optional[float]:
+    """FLOPs of one invocation of a jitted function, from XLA's cost model
+    of the compiled executable.  None when the backend has no cost model."""
+    try:
+        analysis = jitted.lower(*args, **kwargs).compile().cost_analysis()
+    except Exception:
+        return None
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else None
+    if not analysis:
+        return None
+    flops = analysis.get("flops")
+    return float(flops) if flops and flops > 0 else None
+
+
+def mfu(flops_per_step: Optional[float], step_time_s: float,
+        device: Optional[jax.Device] = None) -> Optional[float]:
+    """Achieved fraction of peak: (FLOPs/step / step_time) / peak.
+    None when either the FLOPs or the chip peak is unknown."""
+    peak = peak_flops(device)
+    if not flops_per_step or not peak or step_time_s <= 0:
+        return None
+    return (flops_per_step / step_time_s) / peak

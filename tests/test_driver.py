@@ -64,13 +64,38 @@ class TestEndToEnd:
     def test_time_limit_caps_steps(self, mesh8):
         # a tiny time budget caps every worker's steps per round
         sims = np.full(8, 8.0)  # 8s probe for 10 batches -> 0.8 s/batch
-        res = train_global(cfg(time_limit=1.6), mesh=mesh8,
-                           simulated_durations=sims, progress=False)
+        res = train_global(
+            cfg(time_limit=1.6), mesh=mesh8, simulated_durations=sims,
+            # keep the measured per-epoch wall consistent with the probe
+            # (0.8 s/batch x 2 capped steps) so the cap stays at 2
+            simulated_round_durations=lambda e: np.full(8, 1.6),
+            progress=False)
         # cap = 1.6/0.8 = 2 batches/worker/epoch -> per local epoch at most
         # 2*16=32 examples contribute
         for i in range(8):
             per_epoch = len(res["all_workers_losses"][i]) / 4  # 4 local epochs
             assert per_epoch <= 2
+
+    def test_midrun_slowdown_shrinks_next_cap(self, mesh8):
+        # VERDICT r1 'Next' #8: the straggler budget must react to MEASURED
+        # round wall time, not just the initial probe.  Worker walls are
+        # uniform in round 0; in round 1 every worker reports a 100x wall.
+        # The cap for round 2 must shrink accordingly.
+        sims = np.full(8, 8.0)  # probe: 0.8 s/batch -> cap 16.0/0.8 = 20
+
+        def walls(epoch):
+            base = np.full(8, 0.8)  # per-epoch wall -> spb stays ~0.8
+            if epoch >= 1:
+                base *= 100.0       # mid-run slowdown
+            return base
+
+        res = train_global(cfg(epochs_global=3, epochs_local=1,
+                               time_limit=16.0),
+                           mesh=mesh8, simulated_durations=sims,
+                           simulated_round_durations=walls, progress=False)
+        caps = res["step_caps"]
+        assert len(caps) == 3
+        assert caps[2][0] < caps[1][0], caps
 
     def test_bert_mlm_end_to_end(self, mesh8):
         # BASELINE ladder entry 5 (BERT MLM): token task with [B, L] labels

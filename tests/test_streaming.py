@@ -1,0 +1,77 @@
+"""Streamed input pipeline (VERDICT r1 'Next' #7).
+
+The streamed round must be numerically EQUIVALENT to the whole-round
+program (same step bodies, same RNG stream), while only ever materializing
+one fixed-shape window per worker on the host.
+"""
+
+import numpy as np
+import pytest
+
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.data.partition import (
+    pack_shard,
+    pack_window,
+)
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+
+
+class TestPackWindow:
+    def test_windows_tile_the_shard(self):
+        rng = np.random.default_rng(0)
+        images = rng.normal(size=(50, 4, 4, 1)).astype(np.float32)
+        labels = rng.integers(0, 10, 50).astype(np.int32)
+        idx = rng.permutation(50)[:37]
+        whole = pack_shard(images, labels, idx, batch_size=5, num_steps=10)
+        w1 = pack_window(images, labels, idx, 5, 0, 4)
+        w2 = pack_window(images, labels, idx, 5, 4, 4)
+        w3 = pack_window(images, labels, idx, 5, 8, 2)
+        for k in range(3):
+            np.testing.assert_array_equal(
+                whole[k], np.concatenate([w1[k], w2[k], w3[k]]))
+
+    def test_empty_shard(self):
+        images = np.zeros((10, 2, 2, 1), np.float32)
+        labels = np.zeros(10, np.int32)
+        x, y, m = pack_window(images, labels, np.array([], np.int64), 2, 3, 2)
+        assert x.shape == (2, 2, 2, 2, 1) and (m == 0).all()
+
+
+class TestStreamedRound:
+    def _cfg(self, **kw):
+        base = dict(model="mlp", dataset="mnist", epochs_global=2,
+                    epochs_local=2, batch_size=16, limit_train_samples=800,
+                    limit_eval_samples=100, compute_dtype="float32",
+                    augment=False, aggregation_by="weights", seed=1)
+        base.update(kw)
+        return Config(**base)
+
+    def test_matches_whole_round_exactly(self, mesh8):
+        # pin the measured-wall straggler feedback so both runs see the
+        # same per-round durations (wall clocks differ run to run)
+        walls = lambda e: np.ones(8)
+        dense = train_global(self._cfg(), mesh=mesh8, progress=False,
+                             simulated_round_durations=walls)
+        streamed = train_global(self._cfg(stream_chunk_steps=2), mesh=mesh8,
+                                progress=False,
+                                simulated_round_durations=walls)
+        # identical step bodies + identical RNG stream => same numbers
+        np.testing.assert_allclose(streamed["global_train_losses"],
+                                   dense["global_train_losses"], rtol=1e-5)
+        np.testing.assert_allclose(streamed["global_val_accuracies"],
+                                   dense["global_val_accuracies"], rtol=1e-5)
+        for i in range(8):
+            np.testing.assert_allclose(streamed["all_workers_losses"][i],
+                                       dense["all_workers_losses"][i],
+                                       rtol=1e-5)
+
+    def test_streamed_with_augment_learns(self, mesh8):
+        res = train_global(self._cfg(augment=True, stream_chunk_steps=4),
+                           mesh=mesh8, progress=False)
+        assert res["global_train_losses"][-1] < res["global_train_losses"][0]
+
+    def test_streamed_disbalanced_runs(self, mesh8):
+        res = train_global(
+            self._cfg(data_mode="disbalanced", stream_chunk_steps=3),
+            mesh=mesh8, progress=False)
+        assert np.isfinite(res["global_train_losses"]).all()
