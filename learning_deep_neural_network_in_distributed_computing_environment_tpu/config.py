@@ -78,6 +78,7 @@ class Config:
     mesh_shape: str = "data=-1"   # e.g. "data=8", "data=4,model=2",
     #                               "data=2,model=2,pipe=2"
     sequence_parallel: str = "none"  # none | ring | all_to_all (for bert)
+    attention_impl: str = "dense"    # dense | flash (Pallas kernel; bert)
     # Streamed input pipeline: >0 = feed the round in chunks of this many
     # steps (host window + async double-buffered transfer) instead of
     # materializing the whole epoch — required at ImageNet scale.
@@ -90,6 +91,7 @@ class Config:
         _choices("topology", self.topology, ("allreduce", "ring", "double_ring"))
         _choices("data_mode", self.data_mode, ("balanced", "disbalanced"))
         _choices("proportionality", self.proportionality, ("inverse", "direct", "uniform"))
+        _choices("attention_impl", self.attention_impl, ("dense", "flash"))
         if not 0.0 <= self.local_weight <= 1.0:
             raise ValueError(f"local_weight must be in [0,1], got {self.local_weight}")
         if not 0.0 <= self.fixed_ratio <= 1.0:
@@ -184,6 +186,9 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--mesh_shape", type=str, default=d.mesh_shape)
     p.add_argument("--sequence_parallel", type=str, default=d.sequence_parallel,
                    choices=["none", "ring", "all_to_all"])
+    p.add_argument("--attention_impl", type=str, default=d.attention_impl,
+                   choices=["dense", "flash"],
+                   help="attention kernel for bert models (flash = Pallas)")
     p.add_argument("--stream_chunk_steps", type=int, default=d.stream_chunk_steps,
                    help="stream the round in chunks of this many steps "
                         "(0 = materialize the whole epoch)")

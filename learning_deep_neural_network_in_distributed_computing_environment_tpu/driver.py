@@ -44,7 +44,7 @@ from .data import (
     train_val_split,
 )
 from . import checkpoint as ckpt_lib
-from .mesh import DATA_AXIS, build_mesh, initialize_distributed
+from .mesh import DATA_AXIS, MODEL_AXIS, build_mesh, initialize_distributed
 from .models import get_model
 from .train import LocalSGDEngine, TrainState, rank0_variables
 
@@ -122,7 +122,28 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     # --- model + engine -------------------------------------------------
     model = build_model_for(cfg, num_classes)
     train_model = None
+    param_specs_fn = None
+    train_kw: dict[str, Any] = {}
+    tp = int(mesh.shape.get(MODEL_AXIS, 1))
+    if tp > 1:
+        # tensor parallelism (Megatron construction, parallel/tp.py):
+        # attention heads + FFN hidden sharded over the 'model' axis; the
+        # dense model (init/probe/final-eval) has the identical parameter
+        # structure, physically sharded per tp_param_specs
+        if not cfg.model.startswith("bert"):
+            raise ValueError(
+                f"a '{MODEL_AXIS}' mesh axis (tensor parallelism) applies "
+                f"to attention models (bert_*); got --model {cfg.model}")
+        from functools import partial
+        from .models.bert import tp_param_specs
+        train_kw.update(tp_size=tp, model_axis=MODEL_AXIS)
+        param_specs_fn = partial(tp_param_specs, axis=MODEL_AXIS)
     if cfg.sequence_parallel != "none":
+        if cfg.attention_impl != "dense":
+            raise ValueError(
+                f"--attention_impl {cfg.attention_impl} cannot combine with "
+                f"--sequence_parallel {cfg.sequence_parallel}: the round "
+                "program's attention is the sequence-parallel kernel")
         from .mesh import SEQ_AXIS
         if SEQ_AXIS not in mesh.shape or mesh.shape[SEQ_AXIS] < 2:
             raise ValueError(
@@ -135,10 +156,18 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                 f"(bert_*); got --model {cfg.model}")
         # the round program runs ring / all-to-all attention over the seq
         # axis; init/probe/final-eval keep the dense twin (same params)
-        train_model = build_model_for(
-            cfg, num_classes, attention_impl=cfg.sequence_parallel,
-            axis_name=SEQ_AXIS)
-    engine = LocalSGDEngine(model, mesh, cfg, train_model=train_model)
+        train_kw.update(attention_impl=cfg.sequence_parallel,
+                        axis_name=SEQ_AXIS)
+    elif cfg.attention_impl != "dense":
+        if not cfg.model.startswith("bert"):
+            raise ValueError(
+                "--attention_impl applies to attention models (bert_*); "
+                f"got --model {cfg.model}")
+        train_kw.update(attention_impl=cfg.attention_impl)
+    if train_kw:
+        train_model = build_model_for(cfg, num_classes, **train_kw)
+    engine = LocalSGDEngine(model, mesh, cfg, train_model=train_model,
+                            param_specs_fn=param_specs_fn)
     sample = trainset.images[:batch]
     state = engine.init_state(jax.random.key(cfg.seed), sample)
 

@@ -2,10 +2,15 @@
 
 ``flash_attention``: blockwise online-softmax attention forward — O(L) VMEM
 instead of the O(L^2) score matrix, the standard flash construction mapped
-onto the MXU/VMEM model (grid over (batch, head, q-block); K/V streamed
-through VMEM inside a ``fori_loop``).  Differentiable via ``custom_vjp``
-with a rematerializing dense backward (a dedicated backward kernel is a
-later optimization).
+onto the MXU/VMEM model.  The K/V loop is the innermost GRID dimension
+(not an in-kernel ``fori_loop``), so Pallas double-buffers the K/V block
+HBM->VMEM copies against compute; the online-softmax state (m, l, acc)
+lives in VMEM scratch and persists across that grid dimension.  Matmul
+inputs stay in the incoming dtype (bf16 on TPU) with float32 MXU
+accumulation — casting inputs to f32 first would halve MXU throughput.
+
+Differentiable via ``custom_vjp`` with a rematerializing dense backward
+(a dedicated backward kernel is a later optimization).
 
 Falls back to the dense XLA path when shapes don't satisfy the tiling
 constraints, and runs in interpreter mode on CPU (tests).
@@ -21,72 +26,101 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-BQ = 128  # query block (MXU-aligned)
-BK = 128  # key/value block
+BQ = 256  # query block (MXU-aligned)
+BK = 512  # key/value block
 
 
 def _interpret() -> bool:
     return jax.default_backend() == "cpu"
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, bk: int):
-    q = q_ref[0, :, 0, :].astype(jnp.float32)           # [BQ, D]
-    seq_k = k_ref.shape[1]
-    bq, d = q.shape
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                  *, scale: float, nk: int):
+    # refs are [1, 1, block, D] tiles of the [B, H, L, D] operands: the TPU
+    # lowering needs the (sublane, lane) = last-two dims to be the tiled
+    # (sequence, head_dim) pair, not (head, head_dim)
+    j = pl.program_id(3)
 
-    def body(i, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(i * bk, bk), 0, :].astype(jnp.float32)  # [BK, D]
-        v = v_ref[0, pl.ds(i * bk, bk), 0, :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale            # [BQ, BK]
-        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        corr = jnp.exp(m - m_new)
-        l = l * corr + p.sum(axis=-1, keepdims=True)
-        acc = acc * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return m_new, l, acc
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    m0 = jnp.full((bq, 1), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    a0 = jnp.zeros((bq, d), jnp.float32)
-    m, l, acc = lax.fori_loop(0, seq_k // bk, body, (m0, l0, a0))
-    o_ref[0, :, 0, :] = (acc / l).astype(o_ref.dtype)
+    q = q_ref[0, 0, :, :]                                # [BQ, D] (bf16 ok)
+    k = k_ref[0, 0, :, :]                                # [BK, D]
+    v = v_ref[0, 0, :, :]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale      # [BQ, BK] f32
+    m_prev = m_ref[...]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    corr = jnp.exp(m_prev - m_new)
+    l_ref[...] = l_ref[...] * corr + p.sum(axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    m_ref[...] = m_new
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        o_ref[0, 0, :, :] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+
+
+def _block_size(l: int, cap: int) -> Optional[int]:
+    """Largest multiple of 128 that divides ``l``, capped at ``cap``."""
+    for b in range(min(cap, l) // 128 * 128, 0, -128):
+        if l % b == 0:
+            return b
+    return None
 
 
 def _flash_forward(q, k, v):
     b, lq, h, d = q.shape
     lk = k.shape[1]
+    bq, bk = _block_size(lq, BQ), _block_size(lk, BK)
     scale = 1.0 / (d ** 0.5)
-    grid = (b, h, lq // BQ)
-    return pl.pallas_call(
-        functools.partial(_flash_kernel, scale=scale, bk=BK),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+    grid = (b, h, lq // bq, lk // bk)
+    # [B, L, H, D] -> [B, H, L, D]: the kernel tiles over (seq, head_dim)
+    qt, kt, vt = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
+    # under shard_map's varying-manual-axes typing the out aval must carry
+    # the same mesh-varying set as the inputs
+    vma = getattr(jax.typeof(qt), "vma", None)
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, scale=scale, nk=lk // bk),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype, vma=vma),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, BQ, 1, d), lambda b_, h_, i: (b_, i, h_, 0),
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, lk, 1, d), lambda b_, h_, i: (b_, 0, h_, 0),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i, j: (b_, h_, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, lk, 1, d), lambda b_, h_, i: (b_, 0, h_, 0),
+            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i, j: (b_, h_, j, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, BQ, 1, d),
-                               lambda b_, h_, i: (b_, i, h_, 0),
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda b_, h_, i, j: (b_, h_, i, 0),
                                memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((bq, 1), jnp.float32),    # running max m
+            pltpu.VMEM((bq, 1), jnp.float32),    # running denom l
+            pltpu.VMEM((bq, d), jnp.float32),    # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
         interpret=_interpret(),
-    )(q, k, v)
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
 
 
 def _supported(q, k) -> bool:
-    return (q.shape[1] % BQ == 0 and k.shape[1] % BK == 0
+    return (_block_size(q.shape[1], BQ) is not None
+            and _block_size(k.shape[1], BK) is not None
             and q.shape[-1] <= 256)
 
 
@@ -115,6 +149,11 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """[B, L, H, D] flash attention; dense fallback off the fast path."""
     from .attention import dot_product_attention
-    if mask is not None or not _supported(q, k):
+    # the Pallas HLO interpreter (CPU test path) cannot lower kernels whose
+    # operands are mesh-varying inside shard_map; the unit tests cover the
+    # kernel outside shard_map and the real path compiles on TPU
+    in_shard_map = bool(getattr(jax.typeof(q), "vma", None))
+    if (mask is not None or not _supported(q, k)
+            or (_interpret() and in_shard_map)):
         return dot_product_attention(q, k, v, mask)
     return _flash(q, k, v)
