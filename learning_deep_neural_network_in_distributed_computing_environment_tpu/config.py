@@ -79,6 +79,7 @@ class Config:
     #                               "data=2,model=2,pipe=2"
     sequence_parallel: str = "none"  # none | ring | all_to_all (for bert)
     attention_impl: str = "dense"    # dense | flash (Pallas kernel; bert)
+    pp_microbatches: int = 0         # GPipe microbatches (0 => pipe size)
     # Streamed input pipeline: >0 = feed the round in chunks of this many
     # steps (host window + async double-buffered transfer) instead of
     # materializing the whole epoch — required at ImageNet scale.
@@ -189,6 +190,9 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--attention_impl", type=str, default=d.attention_impl,
                    choices=["dense", "flash"],
                    help="attention kernel for bert models (flash = Pallas)")
+    p.add_argument("--pp_microbatches", type=int, default=d.pp_microbatches,
+                   help="GPipe microbatches when the mesh has a pipe axis "
+                        "(0 = pipe size)")
     p.add_argument("--stream_chunk_steps", type=int, default=d.stream_chunk_steps,
                    help="stream the round in chunks of this many steps "
                         "(0 = materialize the whole epoch)")

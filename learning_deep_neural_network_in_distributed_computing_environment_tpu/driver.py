@@ -44,7 +44,8 @@ from .data import (
     train_val_split,
 )
 from . import checkpoint as ckpt_lib
-from .mesh import DATA_AXIS, MODEL_AXIS, build_mesh, initialize_distributed
+from .mesh import (DATA_AXIS, MODEL_AXIS, PIPE_AXIS, build_mesh,
+                   initialize_distributed)
 from .models import get_model
 from .train import LocalSGDEngine, TrainState, rank0_variables
 
@@ -120,10 +121,31 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     batch = cfg.batch_size
 
     # --- model + engine -------------------------------------------------
-    model = build_model_for(cfg, num_classes)
     train_model = None
     param_specs_fn = None
+    base_kw: dict[str, Any] = {}   # shared by the dense + train models
     train_kw: dict[str, Any] = {}
+    pp = int(mesh.shape.get(PIPE_AXIS, 1))
+    if pp > 1:
+        # pipeline parallelism (GPipe schedule, parallel/pp.py): the
+        # stacked layer axis shards over 'pipe'; the dense twin must use
+        # the same stacked parameter structure
+        if not cfg.model.startswith("bert"):
+            raise ValueError(
+                f"a '{PIPE_AXIS}' mesh axis (pipeline parallelism) applies "
+                f"to attention models (bert_*); got --model {cfg.model}")
+        if int(mesh.shape.get(MODEL_AXIS, 1)) > 1 \
+                or cfg.sequence_parallel != "none":
+            raise NotImplementedError(
+                "pipeline parallelism does not yet compose with a 'model' "
+                "axis or --sequence_parallel")
+        from functools import partial
+        from .parallel.pp import pp_param_specs
+        base_kw.update(scan_layers=True)
+        train_kw.update(pipeline_axis=PIPE_AXIS, pp_size=pp,
+                        num_microbatches=cfg.pp_microbatches)
+        param_specs_fn = partial(pp_param_specs, axis=PIPE_AXIS)
+    model = build_model_for(cfg, num_classes, **base_kw)
     tp = int(mesh.shape.get(MODEL_AXIS, 1))
     if tp > 1:
         # tensor parallelism (Megatron construction, parallel/tp.py):
@@ -165,7 +187,7 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                 f"got --model {cfg.model}")
         train_kw.update(attention_impl=cfg.attention_impl)
     if train_kw:
-        train_model = build_model_for(cfg, num_classes, **train_kw)
+        train_model = build_model_for(cfg, num_classes, **base_kw, **train_kw)
     engine = LocalSGDEngine(model, mesh, cfg, train_model=train_model,
                             param_specs_fn=param_specs_fn)
     sample = trainset.images[:batch]
@@ -259,7 +281,17 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
 
     # --- the global-epoch loop ------------------------------------------
     results["step_caps"] = []
-    for global_epoch in range(start_epoch, cfg.epochs_global):
+    epoch_iter = range(start_epoch, cfg.epochs_global)
+    pbar = None
+    if progress and jax.process_index() == 0:
+        try:  # the reference's global-epoch bar (trainer.py:27,174)
+            from tqdm import tqdm
+            pbar = tqdm(epoch_iter, desc="Global Epochs",
+                        initial=start_epoch, total=cfg.epochs_global)
+            epoch_iter = pbar
+        except ImportError:
+            pass
+    for global_epoch in epoch_iter:
         # straggler protocol: per-worker step cap from the current
         # sec/batch estimate (probe-seeded, then updated from the measured
         # round wall time below) and the time_limit grace budget
@@ -312,13 +344,33 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         results["worker_specific_val_accuracies"].extend(
             mx["val_acc"][0].tolist())
 
-        if progress:
-            print(f"Global Epoch {global_epoch + 1}/{cfg.epochs_global}: "
-                  f"loss={results['global_train_losses'][-1]:.4f} "
-                  f"acc={results['global_train_accuracies'][-1]:.2f}% "
-                  f"val_loss={results['global_val_losses'][-1]:.4f} "
-                  f"val_acc={results['global_val_accuracies'][-1]:.2f}% "
-                  f"({wall:.1f}s)")
+        if progress and jax.process_index() == 0:
+            # the reference's per-rank per-local-epoch report lines
+            # (trainer.py:109-110); all worker ranks share this process's
+            # stdout, so every rank's lines appear here.  tqdm.write keeps
+            # the live bar from garbling them.
+            say = pbar.write if pbar is not None else print
+            for r in range(n):
+                for e in range(epochs_local):
+                    say(f"Rank {r}, Global Epoch {global_epoch + 1}, "
+                        f"Local Epoch {e + 1}, "
+                        f"Loss: {mx['train_loss'][r, e]}, "
+                        f"Accuracy: {mx['train_acc'][r, e]}")
+                    say(f"Worker {r}, Global Epoch {global_epoch + 1}, "
+                        f"Validation Loss: {mx['val_loss'][r, e]:.4f}, "
+                        f"Validation Accuracy: {mx['val_acc'][r, e]:.2f}%")
+            if pbar is not None:  # trainer.py:174 postfix
+                pbar.set_postfix(
+                    loss=results["global_train_losses"][-1],
+                    accuracy=results["global_train_accuracies"][-1],
+                    wall=f"{wall:.1f}s")
+            else:
+                print(f"Global Epoch {global_epoch + 1}/{cfg.epochs_global}: "
+                      f"loss={results['global_train_losses'][-1]:.4f} "
+                      f"acc={results['global_train_accuracies'][-1]:.2f}% "
+                      f"val_loss={results['global_val_losses'][-1]:.4f} "
+                      f"val_acc={results['global_val_accuracies'][-1]:.2f}% "
+                      f"({wall:.1f}s)")
 
         # --- measured straggler feedback (trainer.py:112-119, 179-188) ---
         # The reference updates its view of worker speed from the measured
@@ -373,6 +425,8 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             ckpt_lib.save_checkpoint(cfg.checkpoint_dir, state,
                                      global_epoch + 1)
 
+    if pbar is not None:
+        pbar.close()
     if profiling:
         jax.profiler.stop_trace()
 

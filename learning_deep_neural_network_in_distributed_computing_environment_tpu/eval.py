@@ -72,8 +72,19 @@ def evaluate(model, variables, images: np.ndarray, labels: np.ndarray,
         _, (preds, lsums, csums, wsums) = jax.lax.scan(step, 0, (x, y, m))
         return preds, lsums.sum(), csums.sum(), wsums.sum()
 
+    bar = None
+    if verbose:
+        try:  # the reference's "Testing" bar (evaluator.py:15,30-31); the
+            # whole pass is ONE compiled scan here, so it completes at once
+            from tqdm import tqdm
+            bar = tqdm(total=steps, desc="Testing")
+        except ImportError:
+            pass
     preds, loss_sum, correct, weight = jax.device_get(run(
         jnp.asarray(x), jnp.asarray(y), jnp.asarray(m)))
+    if bar is not None:
+        bar.update(steps)
+        bar.close()
     preds = preds.reshape(-1, *labels.shape[1:])[:n]
     weight = max(float(weight), 1.0)
     loss = float(loss_sum) / weight

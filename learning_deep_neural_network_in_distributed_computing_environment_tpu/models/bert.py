@@ -89,8 +89,39 @@ class EncoderLayer(nn.Module):
         return nn.LayerNorm(epsilon=1e-12, name="ln_ffn")(x + f)
 
 
+class _ScanLayer(nn.Module):
+    """carry-API adapter so ``nn.scan`` can stack EncoderLayers."""
+
+    num_heads: int
+    ffn_dim: int
+    dtype: Any = jnp.float32
+    attention_impl: str = "dense"
+    axis_name: Optional[str] = None
+    tp_size: int = 1
+    model_axis: Optional[str] = None
+    train: bool = False
+
+    @nn.compact
+    def __call__(self, x, _):
+        y = EncoderLayer(self.num_heads, self.ffn_dim, dtype=self.dtype,
+                         attention_impl=self.attention_impl,
+                         axis_name=self.axis_name, tp_size=self.tp_size,
+                         model_axis=self.model_axis, name="layer")(
+                             x, train=self.train)
+        return y, None
+
+
 class BertForMLM(nn.Module):
-    """Token ids [B, L] -> MLM logits [B, L, vocab]."""
+    """Token ids [B, L] -> MLM logits [B, L, vocab].
+
+    ``scan_layers=True`` stores the encoder stack STACKED (one ``layers``
+    collection with a leading [num_layers] axis, applied via ``nn.scan``)
+    instead of ``layer{i}`` loop unrolling — required for pipeline
+    parallelism (the layer axis is what shards over ``pipe``) and much
+    faster to compile at depth.  ``pipeline_axis``/``pp_size`` run the
+    stack as a GPipe schedule (``parallel/pp.py``): this device applies
+    its ``num_layers/pp_size`` local layers per schedule step.
+    """
 
     num_classes: int = 30522       # vocab size (engine passes num_classes)
     num_layers: int = 12
@@ -103,6 +134,11 @@ class BertForMLM(nn.Module):
     axis_name: Optional[str] = None
     tp_size: int = 1
     model_axis: Optional[str] = None
+    scan_layers: bool = False
+    pipeline_axis: Optional[str] = None
+    pp_size: int = 1               # pipe-axis size (static; local layer
+    #                                count = num_layers // pp_size)
+    num_microbatches: int = 0      # 0 => pp_size
 
     @nn.compact
     def __call__(self, input_ids, *, train: bool = False):
@@ -119,12 +155,17 @@ class BertForMLM(nn.Module):
                        name="pos_emb")(pos_ids[None, :])
         x = nn.LayerNorm(epsilon=1e-12, name="ln_emb")(tok + pos)
         x = jnp.asarray(x, self.dtype)
-        for i in range(self.num_layers):
-            x = EncoderLayer(self.num_heads, self.ffn_dim, dtype=self.dtype,
-                             attention_impl=self.attention_impl,
-                             axis_name=self.axis_name, tp_size=self.tp_size,
-                             model_axis=self.model_axis,
-                             name=f"layer{i}")(x, train=train)
+        if self.scan_layers:
+            x = self._encode_scanned(x, train)
+        else:
+            for i in range(self.num_layers):
+                x = EncoderLayer(self.num_heads, self.ffn_dim,
+                                 dtype=self.dtype,
+                                 attention_impl=self.attention_impl,
+                                 axis_name=self.axis_name,
+                                 tp_size=self.tp_size,
+                                 model_axis=self.model_axis,
+                                 name=f"layer{i}")(x, train=train)
         # untied MLM head: transform + LayerNorm + decode (replicated along
         # the model axis; vocab-parallel decode is a later optimization)
         x = jnp.asarray(x, jnp.float32)
@@ -133,6 +174,42 @@ class BertForMLM(nn.Module):
         x = nn.LayerNorm(epsilon=1e-12, name="mlm_ln")(x)
         return nn.Dense(self.num_classes, kernel_init=_init,
                         name="mlm_decoder")(x)
+
+    def _encode_scanned(self, x, train: bool):
+        if self.num_layers % self.pp_size:
+            raise ValueError(f"num_layers {self.num_layers} not divisible "
+                             f"by pp_size {self.pp_size}")
+        n_local = self.num_layers // self.pp_size
+        scanned = nn.scan(
+            _ScanLayer, variable_axes={"params": 0},
+            split_rngs={"params": True}, length=n_local)(
+                self.num_heads, self.ffn_dim, dtype=self.dtype,
+                attention_impl=self.attention_impl, axis_name=self.axis_name,
+                tp_size=self.tp_size, model_axis=self.model_axis,
+                train=train, name="layers")
+        if self.pipeline_axis is None:
+            return scanned(x, None)[0]
+
+        from ..parallel.pp import gpipe_carry0, gpipe_finalize, gpipe_step
+        m = self.num_microbatches or self.pp_size
+        b = x.shape[0]
+        if b % m:
+            raise ValueError(f"per-worker batch {b} not divisible by "
+                             f"{m} microbatches")
+        xs = x.reshape(m, b // m, *x.shape[1:])
+
+        def sched_step(enc, carry, t):
+            # parameters broadcast across schedule steps (weight reuse);
+            # gpipe_step handles inject/compute/record/rotate
+            return gpipe_step(lambda inp: enc(inp, None)[0], xs,
+                              self.pipeline_axis, m, carry, t), None
+
+        sched = nn.scan(sched_step, variable_broadcast="params",
+                        split_rngs={"params": False})
+        steps = jnp.arange(m + self.pp_size - 1)
+        (_, outs), _ = sched(scanned, gpipe_carry0(xs, self.pipeline_axis),
+                             steps)
+        return gpipe_finalize(outs, self.pipeline_axis).reshape(x.shape)
 
 
 def tp_param_specs(params, axis: str = "model"):

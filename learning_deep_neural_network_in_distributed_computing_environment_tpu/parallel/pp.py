@@ -1,0 +1,111 @@
+"""Pipeline parallelism (GPipe-style) over the ``pipe`` mesh axis.
+
+Beyond-reference capability (the reference is data-parallel only,
+SURVEY.md 2.3).  TPU-first formulation: the schedule is ONE SPMD program
+under ``shard_map`` —
+
+- the encoder's layer stack is stored stacked ([num_layers, ...] leaves,
+  ``scan_layers=True`` models) and the leading layer axis is sharded over
+  ``pipe``: stage ``s`` physically holds layers ``[s*L/P, (s+1)*L/P)`` and
+  applies them with a layer ``scan``;
+- the batch is split into M microbatches; at schedule step ``t`` stage
+  ``s`` processes microbatch ``t - s`` (the classic GPipe diagonal), and
+  activations move stage->stage with a single ring ``ppermute`` per step;
+- invalid (bubble) steps compute on zero activations and their results
+  are discarded by masking, keeping every device on the same program —
+  the SPMD answer to the bubble, no host control flow;
+- the backward pass is jax autodiff through the schedule scan: ppermute
+  transposes to the reverse rotation, so cotangents flow backward through
+  the pipeline automatically (GPipe's all-activations-live memory
+  profile; 1F1B scheduling is a later optimization).
+
+Embeddings and the task head run replicated on every pipe stage (their
+parameters are replicated; encoder activations dominate memory), which
+keeps the loss and its gradients identical across the ``pipe`` axis —
+shard_map's varying-axes autodiff then yields exact replicated-parameter
+gradients with no post-hoc correction, as with tensor parallelism
+(``parallel/tp.py``).
+
+``gpipe_step``/``gpipe_finalize`` are the schedule bodies; they are shared
+by the pure ``gpipe_schedule`` (unit tests) and the flax ``nn.scan``
+driver inside ``models.bert`` (which must lift the scan so the stage
+module's parameters broadcast across schedule steps).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def gpipe_step(apply_fn: Callable, xs: jnp.ndarray, axis_name: str,
+               num_micro: int, carry, t):
+    """One schedule step.  ``apply_fn(inp)`` runs this stage's layer block;
+    ``xs`` [M, mb, ...] holds the microbatched pipeline inputs; ``carry``
+    is ``(act_in, outs)``: the activation that just arrived from the
+    predecessor stage and the finished-microbatch collection buffer."""
+    p = lax.axis_size(axis_name)
+    s = lax.axis_index(axis_name)
+    act_in, outs = carry
+    # stage 0 injects microbatch t; later stages consume what arrived
+    x_t = xs[jnp.clip(t, 0, num_micro - 1)]
+    inp = jnp.where(s == 0, x_t, act_in)
+    y = apply_fn(inp)
+    # the last stage finished microbatch t - (p-1) at this step
+    done = t - (p - 1)
+    record = (s == p - 1) & (done >= 0)
+    outs = jnp.where(record, outs.at[jnp.clip(done, 0, num_micro - 1)].set(y),
+                     outs)
+    act_next = lax.ppermute(y, axis_name, [(i, (i + 1) % p) for i in range(p)])
+    return act_next, outs
+
+
+def gpipe_finalize(outs: jnp.ndarray, axis_name: str) -> jnp.ndarray:
+    """Broadcast the last stage's collected outputs to every stage so the
+    replicated head computes one identical loss along ``pipe``."""
+    p = lax.axis_size(axis_name)
+    s = lax.axis_index(axis_name)
+    return lax.psum(jnp.where(s == p - 1, outs, jnp.zeros_like(outs)),
+                    axis_name)
+
+
+def gpipe_schedule(stage_fn: Callable, xs: jnp.ndarray, axis_name: str,
+                   num_micro: int) -> jnp.ndarray:
+    """Pure-function pipeline: ``xs`` [M, mb, ...] -> [M, mb, ...] final
+    activations, identical on every stage.  (Models go through the flax
+    ``nn.scan`` path in ``models.bert`` instead — parameters must be
+    lifted; this entry point serves parameterless stage fns and tests.)"""
+    p = lax.axis_size(axis_name)
+
+    def step(carry, t):
+        return gpipe_step(stage_fn, xs, axis_name, num_micro, carry, t), None
+
+    carry0 = gpipe_carry0(xs, axis_name)
+    (_, outs), _ = lax.scan(step, carry0, jnp.arange(num_micro + p - 1))
+    return gpipe_finalize(outs, axis_name)
+
+
+def gpipe_carry0(xs: jnp.ndarray, axis_name: str):
+    """Zero-initialized (act, outs) schedule carry, marked mesh-varying on
+    ``axis_name`` — the loop body makes the carry varying (per-stage
+    activations), so an invariant init would fail shard_map's scan carry
+    type check."""
+    vary = lambda a: lax.pcast(a, (axis_name,), to="varying")
+    return vary(jnp.zeros_like(xs[0])), vary(jnp.zeros_like(xs))
+
+
+def pp_param_specs(params, axis: str = "pipe"):
+    """PartitionSpec tree for a ``scan_layers`` model: every leaf under the
+    stacked ``layers`` collection is sharded over ``axis`` on its leading
+    (layer) dimension, everything else replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    def spec(path, leaf):
+        names = [getattr(p_, "key", str(p_)) for p_ in path]
+        if "layers" in names:
+            return P(axis, *([None] * (leaf.ndim - 1)))
+        return P()
+    return jax.tree_util.tree_map_with_path(spec, params)

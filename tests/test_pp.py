@@ -1,0 +1,129 @@
+"""Pipeline parallelism (GPipe schedule, ``parallel/pp.py``).
+
+Correctness ladder: the pure schedule vs sequential application on a
+4-stage ``pipe`` mesh (forward AND gradients through the ppermute
+pipeline); the scanned-layer BERT vs the loop-unrolled BERT (same math,
+different parameter layout); and end-to-end through the driver on a
+(data=2, pipe=2) mesh against the dense data=2 run.  Beyond-reference
+capability (the reference is data-parallel only, SURVEY.md 2.3).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import get_model
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.parallel.pp import (
+    gpipe_schedule,
+    pp_param_specs,
+)
+
+
+@pytest.fixture(scope="module")
+def pipe_mesh(devices):
+    return Mesh(np.array(devices[:4]), ("pipe",))
+
+
+class TestGpipeSchedule:
+    """Stage function: x -> x * w_s (per-stage weight from a stacked
+    [P, 1] array sharded over pipe), composed = prod(w) * x."""
+
+    def _run(self, pipe_mesh, m=8, mb=2):
+        rng = np.random.default_rng(0)
+        xs = jnp.asarray(rng.normal(size=(m, mb, 16)), jnp.float32)
+        w = jnp.asarray(rng.normal(size=(4, 1)), jnp.float32)
+
+        def fn(w_local, xs):
+            return gpipe_schedule(
+                lambda a: jnp.tanh(a * w_local[0]), xs, "pipe", m)
+
+        sharded = jax.jit(jax.shard_map(
+            fn, mesh=pipe_mesh, in_specs=(P("pipe"), P()), out_specs=P()))
+
+        def ref(w, xs):
+            a = xs
+            for i in range(4):
+                a = jnp.tanh(a * w[i])
+            return a
+        return sharded, ref, w, xs
+
+    def test_forward_matches_sequential(self, pipe_mesh):
+        sharded, ref, w, xs = self._run(pipe_mesh)
+        np.testing.assert_allclose(sharded(w, xs), ref(w, xs), atol=1e-6)
+
+    def test_grads_match_sequential(self, pipe_mesh):
+        sharded, ref, w, xs = self._run(pipe_mesh)
+        g = jax.grad(lambda w, xs: (sharded(w, xs) ** 2).sum(),
+                     argnums=(0, 1))(w, xs)
+        gr = jax.grad(lambda w, xs: (ref(w, xs) ** 2).sum(),
+                      argnums=(0, 1))(w, xs)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(a, b, atol=1e-5)
+
+
+class TestScannedBert:
+    def test_scanned_params_are_stacked(self):
+        m = get_model("bert_tiny", num_classes=97, scan_layers=True)
+        x = jnp.zeros((2, 16), jnp.int32)
+        params = m.init(jax.random.key(0), x, train=False)["params"]
+        qkv = params["layers"]["layer"]["attn"]["qkv"]["kernel"]
+        assert qkv.shape[0] == 2  # bert_tiny: 2 stacked layers
+        specs = pp_param_specs(params, axis="pipe")
+        assert specs["layers"]["layer"]["attn"]["qkv"]["kernel"][0] == "pipe"
+        assert specs["tok_emb"]["embedding"] == P()
+
+    def test_scanned_forward_matches_unrolled(self):
+        """Same per-layer params => identical logits for the two layouts."""
+        loop = get_model("bert_tiny", num_classes=97)
+        scan = get_model("bert_tiny", num_classes=97, scan_layers=True)
+        x = jnp.asarray(
+            np.random.default_rng(0).integers(0, 97, (2, 16)), jnp.int32)
+        pl_ = loop.init(jax.random.key(1), x, train=False)["params"]
+        ps = {k: v for k, v in pl_.items() if not k.startswith("layer")}
+        ps["layers"] = {"layer": jax.tree.map(
+            lambda *ls: jnp.stack(ls), pl_["layer0"], pl_["layer1"])}
+        np.testing.assert_allclose(
+            scan.apply({"params": ps}, x, train=False),
+            loop.apply({"params": pl_}, x, train=False), atol=1e-5)
+
+
+class TestDriverPipelineParallel:
+    """BERT training pipelined over a (data=2, pipe=2) mesh must match the
+    dense data=2 run: same shards, same rng, numerics within fp32
+    tolerance.  (bert_tiny has 2 layers -> one per stage.)"""
+
+    def _run(self, devices, mesh_axes, **kw):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.mesh import build_mesh
+        mesh = build_mesh(mesh_axes, devices)
+        cfg = Config(model="bert_tiny", dataset="synthetic_mlm",
+                     epochs_global=2, epochs_local=1, batch_size=8,
+                     limit_train_samples=128, limit_eval_samples=32,
+                     compute_dtype="float32", augment=False,
+                     aggregation_by="weights", seed=7, **kw)
+        return train_global(cfg, mesh=mesh, progress=False)
+
+    def test_matches_dense_run(self, devices):
+        dense = self._run(devices[:2], {"data": 2})
+        pp = self._run(devices[:4], {"data": 2, "pipe": 2})
+        np.testing.assert_allclose(pp["global_train_losses"],
+                                   dense["global_train_losses"], rtol=2e-3)
+        assert pp["global_train_losses"][-1] < pp["global_train_losses"][0]
+
+    def test_microbatch_override(self, devices):
+        pp = self._run(devices[:4], {"data": 2, "pipe": 2},
+                       pp_microbatches=4)
+        assert np.isfinite(pp["global_train_losses"]).all()
+
+    def test_requires_attention_model(self, devices):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.mesh import build_mesh
+        mesh = build_mesh({"data": 2, "pipe": 2}, devices[:4])
+        cfg = Config(model="mlp", dataset="mnist", limit_train_samples=64,
+                     limit_eval_samples=16, augment=False)
+        with pytest.raises(ValueError, match="pipe"):
+            train_global(cfg, mesh=mesh, progress=False)
