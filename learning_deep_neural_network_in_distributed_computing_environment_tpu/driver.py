@@ -44,8 +44,8 @@ from .data import (
     train_val_split,
 )
 from . import checkpoint as ckpt_lib
-from .mesh import (DATA_AXIS, MODEL_AXIS, PIPE_AXIS, build_mesh,
-                   initialize_distributed)
+from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS,
+                   build_mesh, initialize_distributed)
 from .models import get_model
 from .train import LocalSGDEngine, TrainState, rank0_variables
 
@@ -145,6 +145,30 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         train_kw.update(pipeline_axis=PIPE_AXIS, pp_size=pp,
                         num_microbatches=cfg.pp_microbatches)
         param_specs_fn = partial(pp_param_specs, axis=PIPE_AXIS)
+    ep = int(mesh.shape.get(EXPERT_AXIS, 1))
+    if cfg.num_experts > 0:
+        # MoE FFN (models/moe.py); with an 'expert' mesh axis the stacked
+        # expert weights shard over it (expert parallelism)
+        if not cfg.model.startswith("bert"):
+            raise ValueError(
+                f"--num_experts applies to attention models (bert_*); "
+                f"got --model {cfg.model}")
+        if (pp > 1 or int(mesh.shape.get(MODEL_AXIS, 1)) > 1
+                or cfg.sequence_parallel != "none"):
+            raise NotImplementedError(
+                "MoE does not yet compose with pipeline, tensor, or "
+                "sequence parallelism (per-chunk routing would change the "
+                "capacity and aux-loss semantics)")
+        base_kw.update(num_experts=cfg.num_experts,
+                       capacity_factor=cfg.expert_capacity_factor)
+        if ep > 1:
+            from functools import partial
+            from .models.moe import ep_param_specs
+            train_kw.update(expert_axis=EXPERT_AXIS, ep_size=ep)
+            param_specs_fn = partial(ep_param_specs, axis=EXPERT_AXIS)
+    elif ep > 1:
+        raise ValueError(
+            f"mesh has an '{EXPERT_AXIS}' axis but --num_experts is 0")
     model = build_model_for(cfg, num_classes, **base_kw)
     tp = int(mesh.shape.get(MODEL_AXIS, 1))
     if tp > 1:

@@ -29,6 +29,7 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 PIPE_AXIS = "pipe"
 SEQ_AXIS = "seq"
+EXPERT_AXIS = "expert"
 
 
 def initialize_distributed() -> None:

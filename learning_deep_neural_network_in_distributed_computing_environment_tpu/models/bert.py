@@ -68,6 +68,10 @@ class EncoderLayer(nn.Module):
     axis_name: Optional[str] = None
     tp_size: int = 1
     model_axis: Optional[str] = None
+    num_experts: int = 0           # >0 => MoE FFN (models/moe.py)
+    expert_axis: Optional[str] = None
+    ep_size: int = 1
+    capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x, mask=None, *, train: bool = False):
@@ -77,15 +81,22 @@ class EncoderLayer(nn.Module):
                           axis_name=self.axis_name, tp_size=self.tp_size,
                           model_axis=self.model_axis, name="attn")(x, mask)
         x = nn.LayerNorm(epsilon=1e-12, name="ln_attn")(x + a)
-        f_in = copy_to_tp_region(x, self.model_axis)
-        f = nn.Dense(self.ffn_dim // self.tp_size, kernel_init=_init,
-                     dtype=self.dtype, name="ffn_in")(f_in)
-        f = nn.gelu(f, approximate=False)
-        f = nn.Dense(x.shape[-1], kernel_init=_init, use_bias=False,
-                     dtype=self.dtype, name="ffn_out")(f)
-        f = reduce_from_tp_region(f, self.model_axis)
-        f = f + self.param("ffn_bias", nn.initializers.zeros,
-                           (x.shape[-1],)).astype(f.dtype)
+        if self.num_experts:
+            from .moe import MoEFFN
+            f = MoEFFN(self.num_experts, self.ffn_dim,
+                       capacity_factor=self.capacity_factor,
+                       dtype=self.dtype, expert_axis=self.expert_axis,
+                       ep_size=self.ep_size, name="moe")(x, train=train)
+        else:
+            f_in = copy_to_tp_region(x, self.model_axis)
+            f = nn.Dense(self.ffn_dim // self.tp_size, kernel_init=_init,
+                         dtype=self.dtype, name="ffn_in")(f_in)
+            f = nn.gelu(f, approximate=False)
+            f = nn.Dense(x.shape[-1], kernel_init=_init, use_bias=False,
+                         dtype=self.dtype, name="ffn_out")(f)
+            f = reduce_from_tp_region(f, self.model_axis)
+            f = f + self.param("ffn_bias", nn.initializers.zeros,
+                               (x.shape[-1],)).astype(f.dtype)
         return nn.LayerNorm(epsilon=1e-12, name="ln_ffn")(x + f)
 
 
@@ -139,6 +150,10 @@ class BertForMLM(nn.Module):
     pp_size: int = 1               # pipe-axis size (static; local layer
     #                                count = num_layers // pp_size)
     num_microbatches: int = 0      # 0 => pp_size
+    num_experts: int = 0           # >0 => MoE FFN in every layer
+    expert_axis: Optional[str] = None
+    ep_size: int = 1
+    capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, input_ids, *, train: bool = False):
@@ -156,6 +171,11 @@ class BertForMLM(nn.Module):
         x = nn.LayerNorm(epsilon=1e-12, name="ln_emb")(tok + pos)
         x = jnp.asarray(x, self.dtype)
         if self.scan_layers:
+            if self.num_experts:
+                raise NotImplementedError(
+                    "MoE layers do not yet compose with scan_layers/"
+                    "pipeline parallelism (the sown aux loss would need "
+                    "lifting through nn.scan)")
             x = self._encode_scanned(x, train)
         else:
             for i in range(self.num_layers):
@@ -165,6 +185,10 @@ class BertForMLM(nn.Module):
                                  axis_name=self.axis_name,
                                  tp_size=self.tp_size,
                                  model_axis=self.model_axis,
+                                 num_experts=self.num_experts,
+                                 expert_axis=self.expert_axis,
+                                 ep_size=self.ep_size,
+                                 capacity_factor=self.capacity_factor,
                                  name=f"layer{i}")(x, train=train)
         # untied MLM head: transform + LayerNorm + decode (replicated along
         # the model axis; vocab-parallel decode is a later optimization)

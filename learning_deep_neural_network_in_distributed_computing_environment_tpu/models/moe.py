@@ -1,0 +1,117 @@
+"""Mixture-of-Experts FFN with expert parallelism over the ``expert``
+mesh axis.
+
+Beyond-reference capability (the reference is data-parallel only,
+SURVEY.md 2.3).  Switch-Transformer-style top-1 token routing with a
+capacity limit, formulated TPU-first as dispatch/combine einsums (dense
+one-hot dispatch tensors -> MXU work, no gather/scatter):
+
+- the gate (replicated) scores every token against all ``num_experts``
+  experts; each token goes to its top-1 expert, capped at
+  ``capacity = ceil(capacity_factor * tokens / num_experts)`` tokens per
+  expert (overflow tokens are dropped — the residual connection in the
+  caller carries them through, standard Switch behavior);
+- expert weights are STACKED with a leading [num_experts] axis; under
+  ``shard_map`` that axis is sharded over ``expert`` and each device
+  dispatches only to its local slice, contributing its experts' outputs
+  to a cross-shard ``psum``;
+- the load-balance auxiliary loss (Switch: E * sum(f_e * P_e)) is sown
+  into the ``aux`` variable collection; the training engine adds it to
+  the objective with ``moe_aux_weight``.
+
+The dense twin (``expert_axis=None``, ``ep_size=1``) computes the exact
+same function with the full expert stack — one parameter structure for
+both worlds, as with tensor parallelism.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_init = nn.initializers.normal(stddev=0.02)
+
+
+class MoEFFN(nn.Module):
+    num_experts: int               # GLOBAL expert count
+    ffn_dim: int
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.float32
+    expert_axis: Optional[str] = None  # mesh axis experts shard over
+    ep_size: int = 1               # expert-axis size (local = E / ep_size)
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        b, t, h = x.shape
+        e, ep = self.num_experts, self.ep_size
+        if e % ep:
+            raise ValueError(f"num_experts {e} not divisible by "
+                             f"expert-parallel size {ep}")
+        e_local = e // ep
+        toks = x.reshape(b * t, h)
+        n_tok = b * t
+        cap = max(int(math.ceil(self.capacity_factor * n_tok / e)), 1)
+
+        # --- top-1 routing (computed identically on every expert shard) --
+        gate_logits = nn.Dense(e, use_bias=False, dtype=jnp.float32,
+                               kernel_init=_init, name="gate")(
+                                   toks.astype(jnp.float32))
+        probs = jax.nn.softmax(gate_logits, axis=-1)         # [N, E]
+        expert_idx = jnp.argmax(probs, axis=-1)              # [N]
+        gate = jnp.max(probs, axis=-1)                       # [N]
+        onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
+        # Switch load-balance loss: E * sum_e f_e * P_e
+        self.sow("aux", "load_balance",
+                 e * jnp.sum(onehot.mean(0) * probs.mean(0)))
+        # position of each token within its expert's queue; drop overflow
+        pos = jnp.einsum("ne,ne->n", jnp.cumsum(onehot, axis=0) - 1.0,
+                         onehot).astype(jnp.int32)
+        keep = (pos < cap).astype(jnp.float32)
+        dispatch = (onehot * keep[:, None])[..., None] * jax.nn.one_hot(
+            jnp.clip(pos, 0, cap - 1), cap,
+            dtype=jnp.float32)[:, None, :]                      # [N, E, C]
+
+        # --- local expert slice ------------------------------------------
+        if self.expert_axis is not None:
+            off = lax.axis_index(self.expert_axis) * e_local
+            dispatch_local = lax.dynamic_slice_in_dim(dispatch, off, e_local,
+                                                      axis=1)
+        else:
+            dispatch_local = dispatch
+
+        w1 = self.param("w1", _init, (e_local, h, self.ffn_dim))
+        b1 = self.param("b1", nn.initializers.zeros, (e_local, self.ffn_dim))
+        w2 = self.param("w2", _init, (e_local, self.ffn_dim, h))
+        b2 = self.param("b2", nn.initializers.zeros, (e_local, h))
+
+        dl = dispatch_local.astype(self.dtype)
+        xe = jnp.einsum("nec,nh->ech", dl, toks.astype(self.dtype))
+        h1 = nn.gelu(jnp.einsum("ech,ehf->ecf", xe, w1.astype(self.dtype))
+                     + b1[:, None, :].astype(self.dtype), approximate=False)
+        ye = jnp.einsum("ecf,efh->ech", h1, w2.astype(self.dtype)) \
+            + b2[:, None, :].astype(self.dtype)
+        combine = dl * gate[:, None, None].astype(self.dtype)
+        out = jnp.einsum("nec,ech->nh", combine, ye)
+        if self.expert_axis is not None:
+            out = lax.psum(out, self.expert_axis)
+        return out.reshape(b, t, h)
+
+
+def ep_param_specs(params, axis: str = "expert"):
+    """PartitionSpec tree sharding MoE expert stacks over ``axis`` (no
+    worker axis — the engine prepends it): w1/b1/w2/b2 leaves under any
+    ``moe`` submodule get their leading (expert) dim sharded; the gate and
+    everything else replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    def spec(path, leaf):
+        names = [getattr(p_, "key", str(p_)) for p_ in path]
+        if "moe" in names and "gate" not in names:
+            return P(axis, *([None] * (leaf.ndim - 1)))
+        return P()
+    return jax.tree_util.tree_map_with_path(spec, params)

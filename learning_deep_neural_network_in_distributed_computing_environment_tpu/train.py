@@ -229,7 +229,7 @@ class LocalSGDEngine:
     def _loss_and_metrics(self, params, batch_stats, xb, yb, mb):
         out, mut = self.train_model.apply(
             {"params": params, "batch_stats": batch_stats}, xb, train=True,
-            mutable=["batch_stats"])
+            mutable=["batch_stats", "aux"])
         ce, w, correct = masked_token_stats(out, yb, mb)
         if self.seq_axis:
             # sequence-parallel: this device holds one chunk of every
@@ -243,6 +243,10 @@ class LocalSGDEngine:
         else:
             loss = _masked_mean(ce, w)
             total = w.sum()
+        # MoE load-balance auxiliary losses sown by models/moe.py
+        aux = jax.tree_util.tree_leaves(mut.get("aux", {}))
+        if aux:
+            loss = loss + self.cfg.moe_aux_weight * sum(aux)
         return loss, (mut.get("batch_stats", batch_stats), correct, total)
 
     def _make_step_fns(self, augment: bool):
