@@ -14,6 +14,17 @@ import jax.numpy as jnp
 _xavier = nn.initializers.xavier_uniform()
 
 
+def _avg_pool_2x2(x: jnp.ndarray) -> jnp.ndarray:
+    """2x2/stride-2 average pooling as a reshape-mean (exact for even H, W).
+
+    Equivalent to ``nn.avg_pool(x, (2, 2), strides=(2, 2))`` but avoids
+    ``reduce_window``, whose gradient composed with a small-channel conv
+    gradient hangs this TPU backend's compiler (empirically bisected: conv
+    1->6 grad alone compiles, + reduce_window-backward never finishes)."""
+    b, h, w, c = x.shape
+    return x.reshape(b, h // 2, 2, w // 2, 2, c).mean(axis=(2, 4))
+
+
 class LeNet5(nn.Module):
     num_classes: int = 10
     dtype: Any = jnp.float32
@@ -24,11 +35,11 @@ class LeNet5(nn.Module):
         x = nn.Conv(6, (5, 5), padding="SAME", kernel_init=_xavier,
                     dtype=self.dtype)(x)
         x = nn.tanh(x)
-        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = _avg_pool_2x2(x)
         x = nn.Conv(16, (5, 5), padding="VALID", kernel_init=_xavier,
                     dtype=self.dtype)(x)
         x = nn.tanh(x)
-        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = _avg_pool_2x2(x)
         x = x.reshape(x.shape[0], -1)
         x = nn.tanh(nn.Dense(120, kernel_init=_xavier, dtype=self.dtype)(x))
         x = nn.tanh(nn.Dense(84, kernel_init=_xavier, dtype=self.dtype)(x))

@@ -65,6 +65,37 @@ class TestResNet:
         assert np.isfinite(out).all()
 
 
+class TestLeNet:
+    def test_avg_pool_2x2_matches_nn_avg_pool(self):
+        """The reshape-mean pooling (TPU-backend compile-hang workaround)
+        must be numerically identical to flax's nn.avg_pool."""
+        import flax.linen as nn
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.models.lenet import _avg_pool_2x2
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(3, 28, 28, 6)),
+                        jnp.float32)
+        np.testing.assert_allclose(_avg_pool_2x2(x),
+                                   nn.avg_pool(x, (2, 2), strides=(2, 2)),
+                                   atol=1e-6)
+
+    def test_lenet5_param_count_forward_shape_and_grads(self):
+        """LeNet-5 (SAME 5x5 stem on 28x28): 28->14->10->5 spatial,
+        61,706 params (classic LeCun-98 count with the modern SAME stem)."""
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.models.lenet import LeNet5
+        m = LeNet5(num_classes=10)
+        v = _init(m, (2, 28, 28, 1))
+        assert _param_count(v) == 61_706
+
+        @jax.jit
+        def loss_fn(params):
+            out = m.apply({"params": params}, jnp.ones((2, 28, 28, 1)),
+                          train=True)
+            assert out.shape == (2, 10)
+            return (out ** 2).mean()
+
+        grads = jax.grad(loss_fn)(v["params"])
+        assert all(np.isfinite(g).all() for g in jax.tree.leaves(grads))
+
+
 class TestBert:
     def _tiny(self, **kw):
         from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import get_model
