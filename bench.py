@@ -19,6 +19,7 @@ remote-relay PJRT backends; median of 3 chains damps relay variance.
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import sys
@@ -30,14 +31,16 @@ CACHE = os.path.join(REPO, ".bench_baseline.json")
 
 
 def _chain_rate(step, state, steps: int, chains: int = 3) -> float:
-    """Median steps/sec over ``chains`` chains of ``steps`` dependent steps."""
+    """Median steps/sec over ``chains`` chains of ``steps`` dependent steps.
+
+    State carries forward across chains (never reused after a call) so the
+    step may donate its input buffers."""
     rates = []
     for _ in range(chains):
         t0 = time.perf_counter()
-        s = state
         for _ in range(steps):
-            s = step(s)
-        jax_fetch(s)
+            state = step(state)
+        jax_fetch(state)
         rates.append(steps / (time.perf_counter() - t0))
     rates.sort()
     return rates[len(rates) // 2]
@@ -77,7 +80,7 @@ def measure_model(name: str, input_shape, batch: int, steps: int,
     has_bn = "batch_stats" in variables
     tx = optax.adam(1e-3)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=0)
     def step(state):
         params, batch_stats, opt_state = state
 

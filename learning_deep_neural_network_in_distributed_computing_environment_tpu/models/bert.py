@@ -80,7 +80,10 @@ class EncoderLayer(nn.Module):
                           attention_impl=self.attention_impl,
                           axis_name=self.axis_name, tp_size=self.tp_size,
                           model_axis=self.model_axis, name="attn")(x, mask)
-        x = nn.LayerNorm(epsilon=1e-12, name="ln_attn")(x + a)
+        # LN output follows the compute dtype (flax does the mean/var math
+        # in f32 internally); an f32 LN output would round-trip every
+        # activation through HBM at twice the width
+        x = nn.LayerNorm(epsilon=1e-12, dtype=self.dtype, name="ln_attn")(x + a)
         if self.num_experts:
             from .moe import MoEFFN
             f = MoEFFN(self.num_experts, self.ffn_dim,
@@ -97,7 +100,8 @@ class EncoderLayer(nn.Module):
             f = reduce_from_tp_region(f, self.model_axis)
             f = f + self.param("ffn_bias", nn.initializers.zeros,
                                (x.shape[-1],)).astype(f.dtype)
-        return nn.LayerNorm(epsilon=1e-12, name="ln_ffn")(x + f)
+        return nn.LayerNorm(epsilon=1e-12, dtype=self.dtype,
+                            name="ln_ffn")(x + f)
 
 
 class _ScanLayer(nn.Module):
@@ -191,13 +195,17 @@ class BertForMLM(nn.Module):
                                  capacity_factor=self.capacity_factor,
                                  name=f"layer{i}")(x, train=train)
         # untied MLM head: transform + LayerNorm + decode (replicated along
-        # the model axis; vocab-parallel decode is a later optimization)
-        x = jnp.asarray(x, jnp.float32)
-        x = nn.Dense(self.hidden, kernel_init=_init, name="mlm_dense")(x)
+        # the model axis; vocab-parallel decode is a later optimization).
+        # The head runs in the compute dtype: at bf16 the [*, hidden, vocab]
+        # decode matmul hits the MXU's full bf16 rate and the [B, L, vocab]
+        # logits cost half the HBM; the loss upcasts to f32 for the
+        # log-softmax either way (train.softmax_cross_entropy)
+        x = nn.Dense(self.hidden, kernel_init=_init, dtype=self.dtype,
+                     name="mlm_dense")(x)
         x = nn.gelu(x, approximate=False)
-        x = nn.LayerNorm(epsilon=1e-12, name="mlm_ln")(x)
+        x = nn.LayerNorm(epsilon=1e-12, dtype=self.dtype, name="mlm_ln")(x)
         return nn.Dense(self.num_classes, kernel_init=_init,
-                        name="mlm_decoder")(x)
+                        dtype=self.dtype, name="mlm_decoder")(x)
 
     def _encode_scanned(self, x, train: bool):
         if self.num_layers % self.pp_size:

@@ -45,9 +45,12 @@ class ResBlock(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool) -> jnp.ndarray:
         in_features = x.shape[-1]
+        # BN outputs follow the compute dtype (flax keeps the mean/var math
+        # in float32 regardless); an fp32 BN output would force every
+        # activation through HBM at twice the width
         norm = lambda name: nn.BatchNorm(
             use_running_average=not train, momentum=0.9, epsilon=1e-5,
-            dtype=jnp.float32, name=name)
+            dtype=self.dtype, name=name)
         conv = lambda feats, k, s, name: nn.Conv(
             feats, (k, k), strides=(s, s), padding=[(k // 2, k // 2)] * 2,
             use_bias=False, kernel_init=_xavier, dtype=self.dtype, name=name)
@@ -83,7 +86,7 @@ class EnhancedCNNModel(nn.Module):
         x = nn.Conv(w, (3, 3), padding=[(1, 1), (1, 1)], use_bias=False,
                     kernel_init=_xavier, dtype=self.dtype, name="prep_conv")(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         epsilon=1e-5, dtype=jnp.float32, name="prep_bn")(x)
+                         epsilon=1e-5, dtype=self.dtype, name="prep_bn")(x)
         x = nn.relu(x)
         for i, feats in enumerate((2 * w, 4 * w, 8 * w, 16 * w)):
             x = ResBlock(feats, stride=2, dtype=self.dtype,

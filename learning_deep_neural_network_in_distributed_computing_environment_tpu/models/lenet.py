@@ -2,6 +2,23 @@
 
 Classic LeCun architecture adapted to NHWC/TPU: conv 6@5x5 -> avgpool ->
 conv 16@5x5 -> avgpool -> dense 120 -> 84 -> classes, tanh activations.
+
+TPU-first formulation: the two tiny-channel convolutions (1->6, 6->16) are
+expressed as im2col patch-matmuls and the 2x2 average pools as reshape-means
+instead of ``lax.conv`` / ``reduce_window``.  Two reasons:
+
+1. this backend's compiler takes unbounded time on the gradient of a
+   small-channel conv at batch >= ~192 (empirically bisected: the bare
+   1->6 5x5 conv grad compiles in 4s at B=32, 54s at B=128, and never
+   finishes at B=256, where the im2col form compiles in 11s);
+2. a conv with 1-6 input channels occupies 1-6 of the MXU's 128 lanes,
+   while the im2col matmul has K = kh*kw*cin (25 / 150) — an order of
+   magnitude better systolic-array utilization for the same math.
+
+Per-conv parameter shapes/count are identical to the ``nn.Conv`` version
+(kernel ``[kh, kw, cin, cout]`` + bias); note the module path names in the
+param tree change (``Conv_i`` -> ``ConvIm2Col_i``), so checkpoints saved
+before this rewrite do not restore into it.
 """
 
 from __future__ import annotations
@@ -15,14 +32,53 @@ _xavier = nn.initializers.xavier_uniform()
 
 
 def _avg_pool_2x2(x: jnp.ndarray) -> jnp.ndarray:
-    """2x2/stride-2 average pooling as a reshape-mean (exact for even H, W).
-
-    Equivalent to ``nn.avg_pool(x, (2, 2), strides=(2, 2))`` but avoids
-    ``reduce_window``, whose gradient composed with a small-channel conv
-    gradient hangs this TPU backend's compiler (empirically bisected: conv
-    1->6 grad alone compiles, + reduce_window-backward never finishes)."""
+    """2x2/stride-2 average pooling as a reshape-mean (exact for even H, W);
+    equivalent to ``nn.avg_pool(x, (2, 2), strides=(2, 2))``."""
     b, h, w, c = x.shape
     return x.reshape(b, h // 2, 2, w // 2, 2, c).mean(axis=(2, 4))
+
+
+class ConvIm2Col(nn.Module):
+    """5x5-style conv as patch-extraction + one matmul.
+
+    Numerically identical to ``nn.Conv(features, (kh, kw), padding=...)``
+    with the same (kernel, bias) parameters (parity pinned by
+    tests/test_models_extra.py::TestLeNet).
+    """
+
+    features: int
+    kernel_size: tuple[int, int]
+    padding: str = "SAME"  # SAME | VALID
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        kh, kw = self.kernel_size
+        if self.padding not in ("SAME", "VALID"):
+            raise ValueError(f"padding must be 'SAME' or 'VALID', "
+                             f"got {self.padding!r}")
+        if self.padding == "SAME" and (kh % 2 == 0 or kw % 2 == 0):
+            raise ValueError(
+                "SAME padding here is symmetric k//2 (exact only for odd "
+                f"kernels); nn.Conv pads (k-1)//2 low for even kernels — "
+                f"got kernel_size {self.kernel_size}")
+        cin = x.shape[-1]
+        kernel = self.param("kernel", _xavier, (kh, kw, cin, self.features))
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        x = jnp.asarray(x, self.dtype)
+        kernel = jnp.asarray(kernel, self.dtype)
+        bias = jnp.asarray(bias, self.dtype)
+        if self.padding == "SAME":
+            x = jnp.pad(x, ((0, 0), (kh // 2, kh // 2),
+                            (kw // 2, kw // 2), (0, 0)))
+        b, h, w, _ = x.shape
+        oh, ow = h - kh + 1, w - kw + 1
+        # kh*kw static shifted views; stacking order (di, dj, cin) matches
+        # the [kh, kw, cin, features] kernel reshape below
+        cols = jnp.stack([x[:, di:di + oh, dj:dj + ow, :]
+                          for di in range(kh) for dj in range(kw)], axis=3)
+        cols = cols.reshape(b, oh, ow, kh * kw * cin)
+        return cols @ kernel.reshape(kh * kw * cin, self.features) + bias
 
 
 class LeNet5(nn.Module):
@@ -32,12 +88,10 @@ class LeNet5(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray, *, train: bool = False) -> jnp.ndarray:
         x = jnp.asarray(x, self.dtype)
-        x = nn.Conv(6, (5, 5), padding="SAME", kernel_init=_xavier,
-                    dtype=self.dtype)(x)
+        x = ConvIm2Col(6, (5, 5), padding="SAME", dtype=self.dtype)(x)
         x = nn.tanh(x)
         x = _avg_pool_2x2(x)
-        x = nn.Conv(16, (5, 5), padding="VALID", kernel_init=_xavier,
-                    dtype=self.dtype)(x)
+        x = ConvIm2Col(16, (5, 5), padding="VALID", dtype=self.dtype)(x)
         x = nn.tanh(x)
         x = _avg_pool_2x2(x)
         x = x.reshape(x.shape[0], -1)

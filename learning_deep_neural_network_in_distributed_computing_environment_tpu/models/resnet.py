@@ -23,9 +23,13 @@ class BasicBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, *, train: bool):
+        # BN outputs follow the compute dtype: flax computes the statistics
+        # in float32 internally either way, but a float32 BN output forces
+        # every activation through HBM at twice the width; params/stats
+        # stay fp32
         norm = lambda name: nn.BatchNorm(use_running_average=not train,
                                          momentum=0.9, epsilon=1e-5,
-                                         dtype=jnp.float32, name=name)
+                                         dtype=self.dtype, name=name)
         conv = lambda f, k, s, name: nn.Conv(
             f, (k, k), strides=(s, s), padding=[(k // 2, k // 2)] * 2,
             use_bias=False, kernel_init=_he, dtype=self.dtype, name=name)
@@ -44,9 +48,10 @@ class Bottleneck(nn.Module):
 
     @nn.compact
     def __call__(self, x, *, train: bool):
+        # bf16 BN output (f32 stats internally) — see BasicBlock
         norm = lambda name: nn.BatchNorm(use_running_average=not train,
                                          momentum=0.9, epsilon=1e-5,
-                                         dtype=jnp.float32, name=name)
+                                         dtype=self.dtype, name=name)
         conv = lambda f, k, s, name: nn.Conv(
             f, (k, k), strides=(s, s), padding=[(k // 2, k // 2)] * 2,
             use_bias=False, kernel_init=_he, dtype=self.dtype, name=name)
@@ -80,7 +85,7 @@ class ResNet(nn.Module):
                         use_bias=False, kernel_init=_he, dtype=self.dtype,
                         name="stem_conv")(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         epsilon=1e-5, dtype=jnp.float32, name="stem_bn")(x)
+                         epsilon=1e-5, dtype=self.dtype, name="stem_bn")(x)
         x = nn.relu(x)
         if self.stem == "imagenet":
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1)] * 2)

@@ -77,6 +77,26 @@ class TestLeNet:
                                    nn.avg_pool(x, (2, 2), strides=(2, 2)),
                                    atol=1e-6)
 
+    @pytest.mark.parametrize("padding,cin,cout", [("SAME", 1, 6),
+                                                  ("VALID", 6, 16)])
+    def test_im2col_conv_matches_nn_conv(self, padding, cin, cout):
+        """The im2col patch-matmul conv (TPU compile-hang workaround +
+        MXU-utilization win for tiny channel counts) must match nn.Conv
+        exactly, parameter-for-parameter."""
+        import flax.linen as nn
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.models.lenet import ConvIm2Col
+        x = jnp.asarray(np.random.default_rng(1).normal(size=(4, 14, 14, cin)),
+                        jnp.float32)
+        m = ConvIm2Col(cout, (5, 5), padding=padding)
+        v = m.init(jax.random.key(2), x)
+        assert set(v["params"]) == {"kernel", "bias"}
+        assert v["params"]["kernel"].shape == (5, 5, cin, cout)
+        ref = nn.Conv(cout, (5, 5), padding=padding)
+        out_ref = ref.apply(
+            {"params": {"kernel": v["params"]["kernel"],
+                        "bias": v["params"]["bias"]}}, x)
+        np.testing.assert_allclose(m.apply(v, x), out_ref, atol=1e-5)
+
     def test_lenet5_param_count_forward_shape_and_grads(self):
         """LeNet-5 (SAME 5x5 stem on 28x28): 28->14->10->5 spatial,
         61,706 params (classic LeCun-98 count with the modern SAME stem)."""
