@@ -229,6 +229,7 @@ LADDER = [
     ("resnet18_cifar10", "resnet18", (32, 32, 3), 256, 100, 10, False, 180),
     ("resnet50_imagenet", "resnet50", (224, 224, 3), 128, 20, 1000, False, 300),
     ("bert_base_mlm_l128", "bert_base", (128,), 64, 20, 30522, True, 300),
+    ("gpt2_small_lm_l512", "gpt2_small", (512,), 16, 20, 50257, True, 300),
     ("enhanced_cnn_cifar10", "enhanced_cnn", (32, 32, 3), 256, 100, 10, False, 180),
 ]
 

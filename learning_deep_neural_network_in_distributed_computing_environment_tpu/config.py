@@ -48,8 +48,10 @@ class Config:
 
     # --- framework-level knobs (new, TPU-first) ----------------------------
     model: str = "enhanced_cnn"   # enhanced_cnn | mlp | lenet5 | resnet18 |
-    #                               resnet50 | bert_base
-    dataset: str = "cifar10"      # cifar10 | mnist | imagenet | synthetic_mlm
+    #                               resnet50 | bert_base | gpt2_small (+ tiny
+    #                               test variants)
+    dataset: str = "cifar10"      # cifar10 | mnist | imagenet |
+    #                               synthetic_mlm | synthetic_lm
     num_workers: int = 0          # 0 => use all devices on the mesh data axis
     seed: int = 0
     dtype: str = "float32"        # param dtype

@@ -144,6 +144,28 @@ def _mlm_synthetic(n_train: int, n_test: int, seed: int, seq_len: int = 128,
     return xtr, ytr, xte, yte, vocab
 
 
+def _lm_synthetic(n_train: int, n_test: int, seed: int, seq_len: int = 128,
+                  vocab: int = 1000):
+    """Learnable causal-LM data: arithmetic token progressions (the next
+    token is a deterministic function of any two previous ones), labels
+    shifted one left with the final position -1 (ignore-index) — the
+    standard next-token-prediction layout."""
+    rng = np.random.default_rng(seed)
+
+    def sample(n, rng):
+        base = rng.integers(0, vocab - 2, (n, 1))
+        step = rng.integers(1, 8, (n, 1))
+        pos = np.arange(seq_len)[None, :]
+        toks = ((base + step * pos) % (vocab - 2) + 2).astype(np.int32)
+        labels = np.concatenate(
+            [toks[:, 1:], np.full((n, 1), -1, np.int32)], axis=1)
+        return toks, labels
+
+    xtr, ytr = sample(n_train, rng)
+    xte, yte = sample(n_test, rng)
+    return xtr, ytr, xte, yte, vocab
+
+
 def load_dataset(name: str, data_dir: str = "data", seed: int = 0,
                  limit_train: int = 0, limit_test: int = 0
                  ) -> tuple[Dataset, Dataset]:
@@ -177,6 +199,9 @@ def load_dataset(name: str, data_dir: str = "data", seed: int = 0,
         ncls = 1000
     elif name == "synthetic_mlm":
         xtr, ytr, xte, yte, ncls = _mlm_synthetic(
+            limit_train or 8192, limit_test or 1024, seed)
+    elif name == "synthetic_lm":
+        xtr, ytr, xte, yte, ncls = _lm_synthetic(
             limit_train or 8192, limit_test or 1024, seed)
     else:
         raise ValueError(f"unknown dataset {name!r}")

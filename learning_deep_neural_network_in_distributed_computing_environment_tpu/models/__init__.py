@@ -42,7 +42,24 @@ def get_model(name: str, **kw: Any):
         kw.setdefault("num_heads", 4)
         kw.setdefault("ffn_dim", 128)
         return BertForMLM(**kw)
+    if name == "gpt2_small":
+        from .gpt import GPTForCausalLM
+        return GPTForCausalLM(**kw)
+    if name == "gpt_tiny":
+        # CPU-testable causal LM (same code path as gpt2_small, 2 layers)
+        from .gpt import GPTForCausalLM
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("hidden", 64)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("ffn_dim", 128)
+        return GPTForCausalLM(**kw)
     raise ValueError(f"unknown model {name!r}")
+
+
+def is_attention_model(name: str) -> bool:
+    """True for transformer families (bert_*/gpt_*) — the models that
+    accept attention/parallelism kwargs (TP, SP, PP, attention_impl)."""
+    return name.lower().startswith(("bert", "gpt"))
 
 
 MODEL_INPUT_SPECS = {
@@ -54,4 +71,6 @@ MODEL_INPUT_SPECS = {
     "resnet50": ((224, 224, 3), 1000),
     "bert_base": ((128,), 30522),
     "bert_tiny": ((128,), 30522),
+    "gpt2_small": ((128,), 50257),
+    "gpt_tiny": ((128,), 50257),
 }

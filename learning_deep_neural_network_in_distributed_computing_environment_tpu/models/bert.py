@@ -39,6 +39,7 @@ class SelfAttention(nn.Module):
     axis_name: Optional[str] = None   # mesh axis for seq-parallel attention
     tp_size: int = 1
     model_axis: Optional[str] = None  # mesh axis for tensor parallelism
+    causal: bool = False           # autoregressive masking (decoder models)
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -51,7 +52,7 @@ class SelfAttention(nn.Module):
                               dtype=self.dtype, name="qkv")(x_in)
         q, k, v = (qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :])
         out = attend(q, k, v, mask=mask, impl=self.attention_impl,
-                     axis_name=self.axis_name)
+                     axis_name=self.axis_name, causal=self.causal)
         y = nn.DenseGeneral(d, axis=(-2, -1), kernel_init=_init,
                             use_bias=False, dtype=self.dtype,
                             name="out")(out)
@@ -221,27 +222,9 @@ class BertForMLM(nn.Module):
                 train=train, name="layers")
         if self.pipeline_axis is None:
             return scanned(x, None)[0]
-
-        from ..parallel.pp import gpipe_carry0, gpipe_finalize, gpipe_step
-        m = self.num_microbatches or self.pp_size
-        b = x.shape[0]
-        if b % m:
-            raise ValueError(f"per-worker batch {b} not divisible by "
-                             f"{m} microbatches")
-        xs = x.reshape(m, b // m, *x.shape[1:])
-
-        def sched_step(enc, carry, t):
-            # parameters broadcast across schedule steps (weight reuse);
-            # gpipe_step handles inject/compute/record/rotate
-            return gpipe_step(lambda inp: enc(inp, None)[0], xs,
-                              self.pipeline_axis, m, carry, t), None
-
-        sched = nn.scan(sched_step, variable_broadcast="params",
-                        split_rngs={"params": False})
-        steps = jnp.arange(m + self.pp_size - 1)
-        (_, outs), _ = sched(scanned, gpipe_carry0(xs, self.pipeline_axis),
-                             steps)
-        return gpipe_finalize(outs, self.pipeline_axis).reshape(x.shape)
+        from ..parallel.pp import gpipe_apply_scanned
+        return gpipe_apply_scanned(scanned, x, self.pipeline_axis,
+                                   self.pp_size, self.num_microbatches)
 
 
 def tp_param_specs(params, axis: str = "model"):

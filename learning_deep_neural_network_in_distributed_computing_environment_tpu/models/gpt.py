@@ -1,0 +1,160 @@
+"""GPT-2-style causal language model (beyond-reference model family).
+
+A from-scratch flax decoder (no ``transformers`` dependency): pre-LN
+blocks, learned position embeddings, GELU FFN, and a TIED LM head (logits
+= hidden @ token_embedding^T, the GPT-2 construction — ``gpt2_small``
+matches the canonical 124,439,808-parameter count).  The reference has no
+sequence models at all (its model is a CNN, SURVEY.md 2.3); this family
+extends the framework's BASELINE ladder beyond BERT to autoregressive
+training.
+
+All the parallelism plumbing is shared with BERT (``models/bert.py``):
+
+- attention is ``ops.attention.attend(..., causal=True)`` so the same
+  module runs dense, flash (Pallas causal kernel), or causal ring /
+  Ulysses sequence-parallel attention;
+- tensor parallelism uses the identical Megatron construction and param
+  names (``qkv``/``out``/``ffn_in``/``ffn_out``), so ``bert.tp_param_specs``
+  applies unchanged;
+- ``scan_layers=True`` stacks the blocks for pipeline parallelism
+  (``parallel/pp.py`` GPipe schedule).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..parallel.tp import copy_to_tp_region, reduce_from_tp_region
+from .bert import SelfAttention
+
+_init = nn.initializers.normal(stddev=0.02)
+
+
+class GPTBlock(nn.Module):
+    """Pre-LN decoder block: x + attn(ln1(x)); x + ffn(ln2(x))."""
+
+    num_heads: int
+    ffn_dim: int                   # GLOBAL FFN width
+    dtype: Any = jnp.float32
+    attention_impl: str = "dense"
+    axis_name: Optional[str] = None
+    tp_size: int = 1
+    model_axis: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        h = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln1")(x)
+        a = SelfAttention(self.num_heads, dtype=self.dtype,
+                          attention_impl=self.attention_impl,
+                          axis_name=self.axis_name, tp_size=self.tp_size,
+                          model_axis=self.model_axis, causal=True,
+                          name="attn")(h)
+        x = x + a
+        f = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln2")(x)
+        f = copy_to_tp_region(f, self.model_axis)
+        f = nn.Dense(self.ffn_dim // self.tp_size, kernel_init=_init,
+                     dtype=self.dtype, name="ffn_in")(f)
+        f = nn.gelu(f, approximate=True)
+        f = nn.Dense(x.shape[-1], kernel_init=_init, use_bias=False,
+                     dtype=self.dtype, name="ffn_out")(f)
+        f = reduce_from_tp_region(f, self.model_axis)
+        f = f + self.param("ffn_bias", nn.initializers.zeros,
+                           (x.shape[-1],)).astype(f.dtype)
+        return x + f
+
+
+class _ScanBlock(nn.Module):
+    """carry-API adapter so ``nn.scan`` can stack GPTBlocks."""
+
+    num_heads: int
+    ffn_dim: int
+    dtype: Any = jnp.float32
+    attention_impl: str = "dense"
+    axis_name: Optional[str] = None
+    tp_size: int = 1
+    model_axis: Optional[str] = None
+    train: bool = False
+
+    @nn.compact
+    def __call__(self, x, _):
+        y = GPTBlock(self.num_heads, self.ffn_dim, dtype=self.dtype,
+                     attention_impl=self.attention_impl,
+                     axis_name=self.axis_name, tp_size=self.tp_size,
+                     model_axis=self.model_axis, name="layer")(
+                         x, train=self.train)
+        return y, None
+
+
+class GPTForCausalLM(nn.Module):
+    """Token ids [B, L] -> next-token logits [B, L, vocab].
+
+    The data pipeline provides shifted labels (``labels[t] = input[t+1]``,
+    final position -1/ignore — ``data/sources.py synthetic_lm``), so the
+    model itself is a pure sequence-to-logits map like BERT.
+    """
+
+    num_classes: int = 50257       # vocab size (engine passes num_classes)
+    num_layers: int = 12
+    hidden: int = 768
+    num_heads: int = 12
+    ffn_dim: int = 3072
+    max_len: int = 1024
+    dtype: Any = jnp.float32
+    attention_impl: str = "dense"
+    axis_name: Optional[str] = None
+    tp_size: int = 1
+    model_axis: Optional[str] = None
+    scan_layers: bool = False
+    pipeline_axis: Optional[str] = None
+    pp_size: int = 1
+    num_microbatches: int = 0      # 0 => pp_size
+
+    @nn.compact
+    def __call__(self, input_ids, *, train: bool = False):
+        b, l = input_ids.shape
+        tok_emb = nn.Embed(self.num_classes, self.hidden,
+                           embedding_init=_init, dtype=self.dtype,
+                           name="tok_emb")
+        tok = tok_emb(input_ids)
+        pos_ids = jnp.arange(l)
+        if self.axis_name is not None:
+            # sequence-parallel: this device holds chunk axis_index of the
+            # sequence, so absolute positions are offset by index * chunk
+            from jax import lax
+            pos_ids = pos_ids + lax.axis_index(self.axis_name) * l
+        pos = nn.Embed(self.max_len, self.hidden, embedding_init=_init,
+                       dtype=self.dtype, name="pos_emb")(pos_ids[None, :])
+        x = jnp.asarray(tok + pos, self.dtype)
+        if self.scan_layers:
+            x = self._decode_scanned(x, train)
+        else:
+            for i in range(self.num_layers):
+                x = GPTBlock(self.num_heads, self.ffn_dim, dtype=self.dtype,
+                             attention_impl=self.attention_impl,
+                             axis_name=self.axis_name, tp_size=self.tp_size,
+                             model_axis=self.model_axis,
+                             name=f"layer{i}")(x, train=train)
+        x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln_f")(x)
+        # tied LM head: logits = x @ tok_emb^T (shares the embedding table)
+        return tok_emb.attend(x)
+
+    def _decode_scanned(self, x, train: bool):
+        if self.num_layers % self.pp_size:
+            raise ValueError(f"num_layers {self.num_layers} not divisible "
+                             f"by pp_size {self.pp_size}")
+        n_local = self.num_layers // self.pp_size
+        scanned = nn.scan(
+            _ScanBlock, variable_axes={"params": 0},
+            split_rngs={"params": True}, length=n_local)(
+                self.num_heads, self.ffn_dim, dtype=self.dtype,
+                attention_impl=self.attention_impl, axis_name=self.axis_name,
+                tp_size=self.tp_size, model_axis=self.model_axis,
+                train=train, name="layers")
+        if self.pipeline_axis is None:
+            return scanned(x, None)[0]
+        from ..parallel.pp import gpipe_apply_scanned
+        return gpipe_apply_scanned(scanned, x, self.pipeline_axis,
+                                   self.pp_size, self.num_microbatches)

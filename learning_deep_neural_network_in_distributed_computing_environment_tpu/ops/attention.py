@@ -1,13 +1,19 @@
 """Attention ops: one entry point, three implementations.
 
-``attend(q, k, v, impl=...)`` with tensors in [batch, seq, heads, head_dim]:
+``attend(q, k, v, impl=..., causal=...)`` with tensors in
+[batch, seq, heads, head_dim]:
 
 - ``dense``: reference XLA dot-product attention (fp32 softmax);
 - ``flash``: Pallas blockwise-softmax kernel (``ops.pallas_ops``), falling
   back to dense where Pallas TPU lowering is unavailable;
 - ``ring``:  ring attention over a sequence-sharded mesh axis
   (``parallel.sp``) — each device holds a sequence block and K/V blocks
-  rotate around the ICI ring with online-softmax accumulation.
+  rotate around the ICI ring with online-softmax accumulation;
+- ``all_to_all``: Ulysses-style sequence parallelism (``parallel.sp``).
+
+``causal=True`` gives autoregressive (decoder) masking in every impl:
+dense masks the score matrix, flash skips fully-future blocks in-kernel,
+ring masks per rotation step by source-chunk position.
 
 The reference has no attention at all (its model is a CNN; SURVEY.md 2.3) —
 this subsystem is the long-context capability required of the framework.
@@ -23,13 +29,25 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def causal_mask(lq: int, lk: int, q_offset: int = 0, k_offset: int = 0):
+    """[lq, lk] bool mask: query at global position q_offset+i may attend
+    key positions <= it."""
+    qpos = q_offset + jnp.arange(lq)[:, None]
+    kpos = k_offset + jnp.arange(lk)[None, :]
+    return kpos <= qpos
+
+
 def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                          mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                          mask: Optional[jnp.ndarray] = None,
+                          causal: bool = False) -> jnp.ndarray:
     """[B, Lq, H, D] x [B, Lk, H, D] -> [B, Lq, H, D]; softmax in fp32."""
     d = q.shape[-1]
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) / jnp.sqrt(
                        jnp.asarray(d, jnp.float32))
+    if causal:
+        cm = causal_mask(q.shape[1], k.shape[1])
+        mask = cm if mask is None else jnp.logical_and(mask, cm)
     if mask is not None:
         s = jnp.where(mask, s, NEG_INF)
     w = jax.nn.softmax(s, axis=-1)
@@ -39,23 +57,24 @@ def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
 def attend(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
            mask: Optional[jnp.ndarray] = None, impl: str = "dense",
-           axis_name: Optional[str] = None) -> jnp.ndarray:
+           axis_name: Optional[str] = None,
+           causal: bool = False) -> jnp.ndarray:
     if impl == "dense":
-        return dot_product_attention(q, k, v, mask)
+        return dot_product_attention(q, k, v, mask, causal=causal)
     if impl == "flash":
         from .pallas_ops import flash_attention
-        return flash_attention(q, k, v, mask)
+        return flash_attention(q, k, v, mask, causal=causal)
     if impl in ("ring", "all_to_all"):
         if axis_name is None:
             raise ValueError(f"{impl} attention requires axis_name (the mesh "
                              "axis the sequence is sharded over)")
         if mask is not None:
             raise NotImplementedError(
-                f"{impl} attention currently supports full bidirectional "
-                "attention (mask=None)")
+                f"{impl} attention supports full bidirectional or causal "
+                "attention (mask=None); arbitrary masks are not sharded")
         if impl == "ring":
             from ..parallel.sp import ring_attention
-            return ring_attention(q, k, v, axis_name)
+            return ring_attention(q, k, v, axis_name, causal=causal)
         from ..parallel.sp import ulysses_attention
-        return ulysses_attention(q, k, v, axis_name)
+        return ulysses_attention(q, k, v, axis_name, causal=causal)
     raise ValueError(f"unknown attention impl {impl!r}")

@@ -29,6 +29,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .attention import NEG_INF
+
 BQ = 256  # query block (MXU-aligned)
 BK = 512  # key/value block
 
@@ -38,10 +40,11 @@ def _interpret() -> bool:
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
-                  *, scale: float, nk: int):
+                  *, scale: float, nk: int, bq: int, bk: int, causal: bool):
     # refs are [1, 1, block, D] tiles of the [B, H, L, D] operands: the TPU
     # lowering needs the (sublane, lane) = last-two dims to be the tiled
     # (sequence, head_dim) pair, not (head, head_dim)
+    i = pl.program_id(2)
     j = pl.program_id(3)
 
     @pl.when(j == 0)
@@ -50,21 +53,33 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0, 0, :, :]                                # [BQ, D] (bf16 ok)
-    k = k_ref[0, 0, :, :]                                # [BK, D]
-    v = v_ref[0, 0, :, :]
-    s = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32) * scale      # [BQ, BK] f32
-    m_prev = m_ref[...]
-    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
-    p = jnp.exp(s - m_new)
-    corr = jnp.exp(m_prev - m_new)
-    l_ref[...] = l_ref[...] * corr + p.sum(axis=-1, keepdims=True)
-    acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
-        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
-    m_ref[...] = m_new
+    # causal: K block j is entirely in the future of Q block i when its
+    # first key position exceeds the block's last query position — skip it
+    # (j == 0 always computes: every query can attend key 0, so the running
+    # max is real from the first processed block on)
+    run = (j * bk <= i * bq + bq - 1) if causal else True
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[0, 0, :, :]                            # [BQ, D] (bf16 ok)
+        k = k_ref[0, 0, :, :]                            # [BK, D]
+        v = v_ref[0, 0, :, :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [BQ, BK] f32
+        if causal:
+            qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_ref[...] = l_ref[...] * corr + p.sum(axis=-1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
 
     @pl.when(j == nk - 1)
     def _finish():
@@ -79,7 +94,7 @@ def _block_size(l: int, cap: int) -> Optional[int]:
     return None
 
 
-def _flash_forward(q, k, v):
+def _flash_forward(q, k, v, causal=False):
     b, lq, h, d = q.shape
     lk = k.shape[1]
     bq, bk = _block_size(lq, BQ), _block_size(lk, BK)
@@ -91,7 +106,8 @@ def _flash_forward(q, k, v):
     # the same mesh-varying set as the inputs
     vma = getattr(jax.typeof(qt), "vma", None)
     out = pl.pallas_call(
-        functools.partial(_flash_kernel, scale=scale, nk=lk // bk),
+        functools.partial(_flash_kernel, scale=scale, nk=lk // bk,
+                          bq=bq, bk=bk, causal=causal),
         out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype, vma=vma),
         grid=grid,
         in_specs=[
@@ -124,21 +140,23 @@ def _supported(q, k) -> bool:
             and q.shape[-1] <= 256)
 
 
-@jax.custom_vjp
-def _flash(q, k, v):
-    return _flash_forward(q, k, v)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flash(q, k, v, causal=False):
+    return _flash_forward(q, k, v, causal)
 
 
-def _flash_fwd_rule(q, k, v):
-    return _flash_forward(q, k, v), (q, k, v)
+def _flash_fwd_rule(q, k, v, causal):
+    return _flash_forward(q, k, v, causal), (q, k, v)
 
 
-def _flash_bwd_rule(res, g):
+def _flash_bwd_rule(causal, res, g):
     # rematerializing backward through the dense reference (correctness
     # first; a blockwise backward kernel is the follow-up optimization)
     from .attention import dot_product_attention
     q, k, v = res
-    _, vjp = jax.vjp(dot_product_attention, q, k, v)
+    _, vjp = jax.vjp(
+        lambda q, k, v: dot_product_attention(q, k, v, causal=causal),
+        q, k, v)
     return vjp(g)
 
 
@@ -146,7 +164,8 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                    mask: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                    mask: Optional[jnp.ndarray] = None,
+                    causal: bool = False) -> jnp.ndarray:
     """[B, L, H, D] flash attention; dense fallback off the fast path."""
     from .attention import dot_product_attention
     # the Pallas HLO interpreter (CPU test path) cannot lower kernels whose
@@ -155,5 +174,5 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     in_shard_map = bool(getattr(jax.typeof(q), "vma", None))
     if (mask is not None or not _supported(q, k)
             or (_interpret() and in_shard_map)):
-        return dot_product_attention(q, k, v, mask)
-    return _flash(q, k, v)
+        return dot_product_attention(q, k, v, mask, causal=causal)
+    return _flash(q, k, v, causal)

@@ -97,6 +97,34 @@ def gpipe_carry0(xs: jnp.ndarray, axis_name: str):
     return vary(jnp.zeros_like(xs[0])), vary(jnp.zeros_like(xs))
 
 
+def gpipe_apply_scanned(scanned, x: jnp.ndarray, axis_name: str,
+                        pp_size: int, num_microbatches: int = 0
+                        ) -> jnp.ndarray:
+    """Run a flax ``nn.scan``-stacked block module through the GPipe
+    schedule: microbatch the [B, ...] activations, lift the schedule scan
+    so the stage parameters broadcast across steps, and return [B, ...]
+    outputs identical on every stage.  Shared by ``models.bert`` and
+    ``models.gpt``."""
+    import flax.linen as nn
+
+    m = num_microbatches or pp_size
+    b = x.shape[0]
+    if b % m:
+        raise ValueError(f"per-worker batch {b} not divisible by "
+                         f"{m} microbatches")
+    xs = x.reshape(m, b // m, *x.shape[1:])
+
+    def sched_step(mod, carry, t):
+        return gpipe_step(lambda inp: mod(inp, None)[0], xs,
+                          axis_name, m, carry, t), None
+
+    sched = nn.scan(sched_step, variable_broadcast="params",
+                    split_rngs={"params": False})
+    steps = jnp.arange(m + pp_size - 1)
+    (_, outs), _ = sched(scanned, gpipe_carry0(xs, axis_name), steps)
+    return gpipe_finalize(outs, axis_name).reshape(x.shape)
+
+
 def pp_param_specs(params, axis: str = "pipe"):
     """PartitionSpec tree for a ``scan_layers`` model: every leaf under the
     stacked ``layers`` collection is sharded over ``axis`` on its leading

@@ -20,22 +20,39 @@ import jax.numpy as jnp
 from jax import lax
 
 
+from ..ops.attention import NEG_INF, causal_mask
+
+
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                   axis_name: str) -> jnp.ndarray:
-    """Blockwise ring attention (bidirectional, no mask).
+                   axis_name: str, causal: bool = False) -> jnp.ndarray:
+    """Blockwise ring attention (bidirectional or causal).
 
     Args: q, k, v [B, Lc, H, D] — the local sequence chunk on each device of
     the ``axis_name`` ring.  Returns the local chunk of the attention output,
     exactly equal to dense attention over the gathered sequence.
+
+    ``causal=True``: at rotation step t this device holds the K/V chunk
+    that started on device ``(idx - t) mod n``, so global key positions are
+    ``src*Lc + j`` against query positions ``idx*Lc + i`` — future chunks
+    mask to -1e30 and contribute exp(-1e30 - m) = 0.  The running max is
+    real from step 0 on (t=0 is the diagonal chunk: every query attends at
+    least itself).  All n rotations still run (lock-step SPMD); the
+    zig-zag block reordering that halves causal ring latency is a later
+    optimization.
     """
     n = lax.axis_size(axis_name)
     b, lc, h, d = q.shape
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     qf = q.astype(jnp.float32)
+    idx = lax.axis_index(axis_name)
 
-    def block(kb, vb):
+    def block(kb, vb, t):
         """Scores of local queries against one K/V block (fp32)."""
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, kb.astype(jnp.float32)) * scale
+        if causal:
+            src = (idx - t) % n                     # chunk's home device
+            cm = causal_mask(lc, lc, q_offset=idx * lc, k_offset=src * lc)
+            s = jnp.where(cm[None, None], s, NEG_INF)
         return s, vb
 
     # online-softmax accumulators.  Under shard_map the scan carry must have
@@ -50,9 +67,9 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     m = vary(jnp.full((b, h, lc), -jnp.inf, jnp.float32))  # running max
     l = vary(jnp.zeros((b, h, lc), jnp.float32))           # running denominator
 
-    def body(carry, _):
+    def body(carry, t):
         kb, vb, o, m, l = carry
-        s, vb_ = block(kb, vb)
+        s, vb_ = block(kb, vb, t)
         m_new = jnp.maximum(m, s.max(axis=-1))
         corr = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[..., None])
@@ -65,21 +82,22 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         vb = lax.ppermute(vb, axis_name, perm)
         return (kb, vb, o, m_new, l), None
 
-    (kb, vb, o, m, l), _ = lax.scan(body, (k, v, o, m, l), None, length=n)
+    (kb, vb, o, m, l), _ = lax.scan(body, (k, v, o, m, l), jnp.arange(n))
     out = (o / l[..., None]).astype(q.dtype)         # [B, H, Lc, D]
     return jnp.transpose(out, (0, 2, 1, 3))          # -> [B, Lc, H, D]
 
 
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                      axis_name: str) -> jnp.ndarray:
+                      axis_name: str, causal: bool = False) -> jnp.ndarray:
     """All-to-all (DeepSpeed-Ulysses-style) sequence parallelism.
 
     Two ``lax.all_to_all``s trade the sequence sharding for a head sharding:
     each device gathers the FULL sequence for ``H/n`` of the heads, runs
-    ordinary dense attention on them, and scatters back to sequence shards.
-    Exact (no online-softmax recurrence); needs ``H % n == 0``; moves 2x the
-    activation bytes of ring attention but in two large dense collectives
-    that XLA overlaps well on ICI.
+    ordinary dense attention on them (causal masking applies directly —
+    positions are global after the gather), and scatters back to sequence
+    shards.  Exact (no online-softmax recurrence); needs ``H % n == 0``;
+    moves 2x the activation bytes of ring attention but in two large dense
+    collectives that XLA overlaps well on ICI.
     """
     n = lax.axis_size(axis_name)
     b, lc, h, d = q.shape
@@ -93,7 +111,8 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
                               tiled=True)
 
-    out = dot_product_attention(to_heads(q), to_heads(k), to_heads(v))
+    out = dot_product_attention(to_heads(q), to_heads(k), to_heads(v),
+                                causal=causal)
     # [B, L, H/n, D] -> [B, Lc, H, D]
     return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
                           tiled=True)

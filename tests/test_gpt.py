@@ -1,0 +1,127 @@
+"""Causal attention (dense/flash/ring/ulysses) + the GPT-2 family.
+
+The reference has no sequence models (SURVEY.md 2.3); this is the
+beyond-reference autoregressive ladder: causal masking in every attention
+impl, the canonical GPT-2-small parameter count, and driver-level e2e
+training under DP / TP / sequence parallelism.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+
+def _qkv(l=128, h=4, d=16, b=2, seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(jnp.asarray(rng.normal(size=(b, l, h, d)), jnp.float32)
+                 for _ in range(3))
+
+
+class TestCausalAttention:
+    def test_dense_causal_equals_masked(self):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.ops.attention import dot_product_attention
+        q, k, v = _qkv()
+        d = dot_product_attention(q, k, v, causal=True)
+        mask = jnp.asarray(np.tril(np.ones((128, 128), bool)))
+        ref = dot_product_attention(q, k, v, mask=mask)
+        np.testing.assert_allclose(d, ref, atol=1e-6)
+        # position 0 attends only itself -> output == v[0]
+        np.testing.assert_allclose(d[:, 0], v[:, 0], atol=1e-6)
+
+    def test_flash_causal_forward_and_grad(self):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.ops.attention import (
+            attend, dot_product_attention)
+        q, k, v = _qkv()
+        d = dot_product_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(attend(q, k, v, impl="flash", causal=True),
+                                   d, atol=1e-5)
+        gf = jax.grad(lambda q: (attend(q, k, v, impl="flash",
+                                        causal=True) ** 2).sum())(q)
+        gd = jax.grad(lambda q: (dot_product_attention(
+            q, k, v, causal=True) ** 2).sum())(q)
+        np.testing.assert_allclose(gf, gd, atol=1e-4)
+
+    @pytest.mark.parametrize("impl", ["ring", "all_to_all"])
+    def test_seq_parallel_causal_matches_dense(self, impl, devices):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.mesh import build_mesh
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.ops.attention import (
+            attend, dot_product_attention)
+        q, k, v = _qkv()
+        mesh = build_mesh({"seq": 4}, devices[:4])
+        f = jax.jit(shard_map(
+            lambda q, k, v: attend(q, k, v, impl=impl, axis_name="seq",
+                                   causal=True),
+            mesh=mesh, in_specs=(P(None, "seq"),) * 3,
+            out_specs=P(None, "seq")))
+        np.testing.assert_allclose(
+            f(q, k, v), dot_product_attention(q, k, v, causal=True),
+            atol=1e-5)
+
+
+class TestGPT:
+    def test_gpt2_small_param_count_canonical(self):
+        """Tied-head GPT-2 small == 124,439,808 params (the published
+        count: wte 50257x768 + wpe 1024x768 + 12 blocks + ln_f)."""
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import get_model
+        m = get_model("gpt2_small")
+        vs = jax.eval_shape(
+            lambda: m.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32)))
+        n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(vs["params"]))
+        assert n == 124_439_808
+
+    def test_gpt_tiny_forward_shape_and_causality(self):
+        """Logits at position t must not depend on tokens after t."""
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import get_model
+        m = get_model("gpt_tiny")
+        x = jnp.asarray(np.random.default_rng(0).integers(2, 100, (2, 16)),
+                        jnp.int32)
+        v = jax.jit(lambda k: m.init(k, x))(jax.random.key(0))
+        out = m.apply(v, x)
+        assert out.shape == (2, 16, 50257)
+        x2 = x.at[:, 8:].set(7)  # perturb the future
+        out2 = m.apply(v, x2)
+        np.testing.assert_allclose(out[:, :8], out2[:, :8], atol=1e-5)
+        assert np.abs(np.asarray(out[:, 8:]) -
+                      np.asarray(out2[:, 8:])).max() > 1e-3
+
+    def test_synthetic_lm_labels_are_shifted_inputs(self):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.data import load_dataset
+        train, test = load_dataset("synthetic_lm", seed=0,
+                                   limit_train=32, limit_test=8)
+        assert train.num_classes == 1000
+        np.testing.assert_array_equal(train.labels[:, :-1],
+                                      train.images[:, 1:])
+        assert (train.labels[:, -1] == -1).all()
+
+    def test_gpt_tiny_e2e_dp_loss_decreases(self, mesh8):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+        cfg = Config(model="gpt_tiny", dataset="synthetic_lm",
+                     epochs_global=2, epochs_local=1, batch_size=8,
+                     limit_train_samples=256, limit_eval_samples=64,
+                     compute_dtype="float32", augment=False,
+                     aggregation_by="weights", seed=0)
+        res = train_global(cfg, mesh=mesh8, progress=False)
+        l = res["global_train_losses"]
+        assert l[-1] < l[0], l
+
+    @pytest.mark.parametrize("axes,extra", [
+        ({"data": 2, "model": 2}, {}),
+        ({"data": 2, "seq": 2}, {"sequence_parallel": "ring"}),
+        ({"data": 2, "pipe": 2}, {}),
+    ], ids=["tensor", "seq_ring", "pipeline"])
+    def test_gpt_tiny_parallel_modes(self, axes, extra, devices):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.mesh import build_mesh
+        mesh = build_mesh(axes, devices[:4])
+        cfg = Config(model="gpt_tiny", dataset="synthetic_lm",
+                     epochs_global=1, epochs_local=1, batch_size=8,
+                     limit_train_samples=128, limit_eval_samples=32,
+                     compute_dtype="float32", augment=False,
+                     aggregation_by="weights", seed=1, **extra)
+        res = train_global(cfg, mesh=mesh, progress=False)
+        assert np.isfinite(res["global_train_losses"]).all()
