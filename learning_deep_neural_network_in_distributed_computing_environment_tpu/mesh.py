@@ -42,7 +42,12 @@ def initialize_distributed() -> None:
     """
     if os.environ.get("JAX_COORDINATOR_ADDRESS"):
         try:
-            jax.distributed.initialize()
+            jax.distributed.initialize(
+                coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+                num_processes=int(os.environ.get("JAX_NUM_PROCESSES", 0))
+                or None,
+                process_id=(int(os.environ["JAX_PROCESS_ID"])
+                            if "JAX_PROCESS_ID" in os.environ else None))
         except RuntimeError:
             pass  # already initialized (e.g. by the TPU runtime)
 
@@ -77,10 +82,31 @@ def build_mesh(axes: dict[str, int] | None = None,
     Device order follows ``jax.devices()``, which on TPU slices enumerates in
     torus-contiguous order, so a 1-D ``data`` axis rides the ICI ring — the
     property the ring/double-ring gossip topologies (ppermute) rely on.
+
+    Multi-host (``jax.process_count() > 1``) without an explicit device
+    list: the mesh is laid out so the LEADING axis (``data`` by
+    construction — ``Config.mesh_axes`` always puts it first) spans hosts.
+    Host-crossing traffic is then the once-per-round parameter sync, while
+    the per-step TP/SP/PP collectives stay on intra-host ICI — the
+    ICI-vs-DCN layout recipe.  ``jax.devices()`` enumerates process-major,
+    so the reshape below gives exactly that: leading-axis blocks map to
+    whole processes.
     """
     devs = list(devices) if devices is not None else list(jax.devices())
     axes = resolve_axes(axes or {DATA_AXIS: -1}, len(devs))
     total = math.prod(axes.values())
+    if (devices is None and jax.process_count() > 1
+            and total == len(devs) and len(axes) > 1):
+        first = next(iter(axes))
+        inner = total // axes[first]
+        # inner (TP/SP/PP) axes stay intra-host only when a host's devices
+        # cover a whole number of inner blocks
+        if jax.local_device_count() % inner != 0:
+            log.warning(
+                "mesh %s does not align inner axes with host boundaries "
+                "(%d inner positions vs %d local devices); per-step "
+                "collectives will cross DCN", axes, inner,
+                jax.local_device_count())
     grid = np.array(devs[:total]).reshape(tuple(axes.values()))
     return Mesh(grid, tuple(axes.keys()))
 

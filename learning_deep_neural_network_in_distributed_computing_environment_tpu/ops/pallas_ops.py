@@ -9,8 +9,12 @@ lives in VMEM scratch and persists across that grid dimension.  Matmul
 inputs stay in the incoming dtype (bf16 on TPU) with float32 MXU
 accumulation — casting inputs to f32 first would halve MXU throughput.
 
-Differentiable via ``custom_vjp`` with a rematerializing dense backward
-(a dedicated backward kernel is a later optimization).
+Differentiable via ``custom_vjp`` with BLOCKWISE backward kernels
+(FlashAttention-2 construction): the forward additionally stores the
+per-row log-sum-exp (lane-broadcast, [B, H, L, 128]); the backward
+recomputes softmax probabilities per block pair from (q, k, lse) and runs
+two passes — a dQ kernel (K/V innermost) and a dK/dV kernel (Q innermost)
+— so training never materializes an L x L score matrix either.
 
 Falls back to the dense XLA path when shapes don't satisfy the tiling
 constraints, and runs in interpreter mode on CPU (tests).
@@ -39,7 +43,10 @@ def _interpret() -> bool:
     return jax.default_backend() == "cpu"
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+LANES = 128  # lane padding for per-row (lse/delta) tensors, TPU tile width
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                   *, scale: float, nk: int, bq: int, bk: int, causal: bool):
     # refs are [1, 1, block, D] tiles of the [B, H, L, D] operands: the TPU
     # lowering needs the (sublane, lane) = last-two dims to be the tiled
@@ -84,6 +91,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
     @pl.when(j == nk - 1)
     def _finish():
         o_ref[0, 0, :, :] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+        if lse_ref is not None:
+            # log-sum-exp residual for the backward kernels, lane-broadcast
+            # to the TPU tile width (the jax in-tree kernel's layout)
+            lse = m_ref[...] + jnp.log(l_ref[...])          # [bq, 1]
+            lse_ref[0, 0, :, :] = jnp.broadcast_to(lse, (lse.shape[0], LANES))
 
 
 def _block_size(l: int, cap: int) -> Optional[int]:
@@ -94,7 +106,13 @@ def _block_size(l: int, cap: int) -> Optional[int]:
     return None
 
 
-def _flash_forward(q, k, v, causal=False):
+def _fwd_kernel_nolse(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                      **kw):
+    _flash_kernel(q_ref, k_ref, v_ref, o_ref, None, m_ref, l_ref, acc_ref,
+                  **kw)
+
+
+def _flash_forward(q, k, v, causal=False, with_lse=False):
     b, lq, h, d = q.shape
     lk = k.shape[1]
     bq, bk = _block_size(lq, BQ), _block_size(lk, BK)
@@ -105,22 +123,31 @@ def _flash_forward(q, k, v, causal=False):
     # under shard_map's varying-manual-axes typing the out aval must carry
     # the same mesh-varying set as the inputs
     vma = getattr(jax.typeof(qt), "vma", None)
+    kw = dict(scale=scale, nk=lk // bk, bq=bq, bk=bk, causal=causal)
+    kernel = (functools.partial(_flash_kernel, **kw) if with_lse
+              else functools.partial(_fwd_kernel_nolse, **kw))
+    o_spec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0),
+                          memory_space=pltpu.VMEM)
+    out_shape = [jax.ShapeDtypeStruct(qt.shape, q.dtype, vma=vma)]
+    out_specs = [o_spec]
+    if with_lse:
+        out_shape.append(jax.ShapeDtypeStruct((b, h, lq, LANES), jnp.float32,
+                                              vma=vma))
+        out_specs.append(pl.BlockSpec(
+            (1, 1, bq, LANES), lambda b_, h_, i, j: (b_, h_, i, 0),
+            memory_space=pltpu.VMEM))
     out = pl.pallas_call(
-        functools.partial(_flash_kernel, scale=scale, nk=lk // bk,
-                          bq=bq, bk=bk, causal=causal),
-        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype, vma=vma),
+        kernel,
+        out_shape=out_shape,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0),
-                         memory_space=pltpu.VMEM),
+            o_spec,
             pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i, j: (b_, h_, j, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i, j: (b_, h_, j, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, 1, bq, d),
-                               lambda b_, h_, i, j: (b_, h_, i, 0),
-                               memory_space=pltpu.VMEM),
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((bq, 1), jnp.float32),    # running max m
             pltpu.VMEM((bq, 1), jnp.float32),    # running denom l
@@ -131,7 +158,159 @@ def _flash_forward(q, k, v, causal=False):
                                  "arbitrary")),
         interpret=_interpret(),
     )(qt, kt, vt)
-    return out.transpose(0, 2, 1, 3)
+    if with_lse:
+        return out[0].transpose(0, 2, 1, 3), out[1]
+    return out[0].transpose(0, 2, 1, 3)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref,
+                   acc_ref, *, scale: float, nk: int, bq: int, bk: int,
+                   causal: bool):
+    """dQ pass: grid (b, h, iq, jk), K/V innermost; accumulates
+    dq_i = sum_j ds_ij k_j with ds = p * (do v^T - delta) * scale."""
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    run = (j * bk <= i * bq + bq - 1) if causal else True
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        lse = lse_ref[0, 0, :, :1]                       # [bq, 1]
+        delta = dl_ref[0, 0, :, :1]                      # [bq, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        p = jnp.exp(s - lse)                             # softmax probs
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, bk]
+        ds = p * (dp - delta) * scale
+        acc_ref[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, d]
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_ref[0, 0, :, :] = acc_ref[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
+                    ni: int, bq: int, bk: int, causal: bool):
+    """dK/dV pass: grid (b, h, jk, iq), Q innermost; accumulates
+    dv_j = sum_i p^T do_i and dk_j = sum_i ds^T q_i."""
+    j = pl.program_id(2)
+    i = pl.program_id(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    run = (j * bk <= i * bq + bq - 1) if causal else True
+
+    @pl.when(run)
+    def _block():
+        q = q_ref[0, 0, :, :]
+        k = k_ref[0, 0, :, :]
+        v = v_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        lse = lse_ref[0, 0, :, :1]
+        delta = dl_ref[0, 0, :, :1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bk, d]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bq, bk]
+        ds = p * (dp - delta) * scale
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [bk, d]
+
+    @pl.when(i == ni - 1)
+    def _finish():
+        dk_ref[0, 0, :, :] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, g, causal):
+    """Blockwise flash backward: O(L) memory, no L x L score materialization
+    (the FlashAttention-2 construction: recompute p from q, k and the saved
+    log-sum-exp, accumulate dq / dk / dv per block pair)."""
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    bq, bk = _block_size(lq, BQ), _block_size(lk, BK)
+    scale = 1.0 / (d ** 0.5)
+    qt, kt, vt, ot, gt = (a.transpose(0, 2, 1, 3) for a in (q, k, v, o, g))
+    # delta_i = rowsum(do * o) — the softmax-jacobian correction term,
+    # lane-broadcast like lse
+    delta = jnp.einsum("bhld,bhld->bhl", gt.astype(jnp.float32),
+                       ot.astype(jnp.float32))
+    delta = jnp.broadcast_to(delta[..., None], (b, h, lq, LANES))
+    vma = getattr(jax.typeof(qt), "vma", None)
+    row = lambda m: pl.BlockSpec((1, 1, bq, m),
+                                 lambda b_, h_, i, j: (b_, h_, i, 0),
+                                 memory_space=pltpu.VMEM)
+    col = lambda m: pl.BlockSpec((1, 1, bk, m),
+                                 lambda b_, h_, i, j: (b_, h_, j, 0),
+                                 memory_space=pltpu.VMEM)
+    # transposed index maps for the dkv grid (b, h, j, i)
+    rowT = lambda m: pl.BlockSpec((1, 1, bq, m),
+                                  lambda b_, h_, j, i: (b_, h_, i, 0),
+                                  memory_space=pltpu.VMEM)
+    colT = lambda m: pl.BlockSpec((1, 1, bk, m),
+                                  lambda b_, h_, j, i: (b_, h_, j, 0),
+                                  memory_space=pltpu.VMEM)
+    params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
+
+    dqt = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, nk=lk // bk,
+                          bq=bq, bk=bk, causal=causal),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype, vma=vma),
+        grid=(b, h, lq // bq, lk // bk),
+        in_specs=[row(d), col(d), col(d), row(d), row(LANES), row(LANES)],
+        out_specs=row(d),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=params, interpret=_interpret(),
+    )(qt, kt, vt, gt, lse, delta)
+
+    dkt, dvt = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, ni=lq // bq,
+                          bq=bq, bk=bk, causal=causal),
+        out_shape=[jax.ShapeDtypeStruct(kt.shape, k.dtype, vma=vma),
+                   jax.ShapeDtypeStruct(vt.shape, v.dtype, vma=vma)],
+        grid=(b, h, lk // bk, lq // bq),
+        in_specs=[rowT(d), colT(d), colT(d), rowT(d), rowT(LANES),
+                  rowT(LANES)],
+        out_specs=[colT(d), colT(d)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=params, interpret=_interpret(),
+    )(qt, kt, vt, gt, lse, delta)
+    return (dqt.transpose(0, 2, 1, 3), dkt.transpose(0, 2, 1, 3),
+            dvt.transpose(0, 2, 1, 3))
 
 
 def _supported(q, k) -> bool:
@@ -146,18 +325,12 @@ def _flash(q, k, v, causal=False):
 
 
 def _flash_fwd_rule(q, k, v, causal):
-    return _flash_forward(q, k, v, causal), (q, k, v)
+    o, lse = _flash_forward(q, k, v, causal, with_lse=True)
+    return o, (q, k, v, o, lse)
 
 
 def _flash_bwd_rule(causal, res, g):
-    # rematerializing backward through the dense reference (correctness
-    # first; a blockwise backward kernel is the follow-up optimization)
-    from .attention import dot_product_attention
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: dot_product_attention(q, k, v, causal=causal),
-        q, k, v)
-    return vjp(g)
+    return _flash_backward(*res, g, causal)
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)

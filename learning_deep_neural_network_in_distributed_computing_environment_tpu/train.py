@@ -71,13 +71,39 @@ class TrainState(struct.PyTreeNode):
     rng: jnp.ndarray         # uint32[2] raw PRNG key per worker
 
 
+def _first_worker_row(x):
+    """``x[0]`` of a worker-stacked leaf, multi-host-safe (no collective).
+
+    A global array whose worker axis spans processes is not fully
+    addressable, so ``x[0]`` would fail off process 0.  Every process
+    instead assembles the first worker row it can address from ALL the
+    addressable shards covering that row — under tensor parallelism one
+    worker row is split over the ``model`` axis into several shards, and
+    taking a single shard would return a fragment.  On process 0 (the
+    consumer of post-training values: rank-0 final eval, ``main.py:61-62``)
+    that is the true worker 0 whenever inner mesh axes are intra-host (the
+    layout ``mesh.build_mesh`` documents); on other processes it is their
+    first local worker — identical right after init (broadcast), which is
+    the only place they consume it (probe)."""
+    if not isinstance(x, jax.Array) or x.is_fully_addressable:
+        return x[0]
+    start = min((s.index[0].start or 0) for s in x.addressable_shards)
+    covering = [s for s in x.addressable_shards
+                if (s.index[0].start or 0) == start]
+    out = np.empty(x.shape[1:], dtype=x.dtype)
+    for s in covering:
+        out[tuple(s.index[1:])] = np.asarray(s.data)[0]
+    return jnp.asarray(out)
+
+
 def rank0_variables(state: "TrainState") -> dict:
     """Worker-0 slice of a stacked TrainState as model.apply variables —
     the reference's rank-0 model for test evaluation (main.py:61-62)."""
-    variables = {"params": jax.tree_util.tree_map(lambda x: x[0], state.params)}
+    variables = {"params": jax.tree_util.tree_map(_first_worker_row,
+                                                  state.params)}
     if jax.tree_util.tree_leaves(state.batch_stats):
         variables["batch_stats"] = jax.tree_util.tree_map(
-            lambda x: x[0], state.batch_stats)
+            _first_worker_row, state.batch_stats)
     return variables
 
 
@@ -148,6 +174,43 @@ class LocalSGDEngine:
         self._spec = P(DATA_AXIS)
 
     # ------------------------------------------------------------------
+    # Multi-host data movement
+    # ------------------------------------------------------------------
+    # The worker (data) axis is laid out process-major over hosts
+    # (mesh.build_mesh), so every [N, ...] worker-stacked array maps whole
+    # leading-row blocks to whole processes.  Single-process: plain
+    # device_put / device_get.  Multi-host: feed with
+    # make_array_from_process_local_data (each process contributes its own
+    # row block) and fetch with process_allgather (replicates the small
+    # metric arrays to every host) — the multihost twins of the
+    # reference's scatter/gather (SURVEY.md 2.4).
+
+    def _local_rows(self, a: np.ndarray):
+        n, p = a.shape[0], jax.process_count()
+        if n % p:
+            raise ValueError(
+                f"worker axis ({n}) not divisible by process count ({p})")
+        per = n // p
+        lo = jax.process_index() * per
+        return a[lo:lo + per]
+
+    def _put(self, a, spec):
+        sharding = NamedSharding(self.mesh, spec)
+        if jax.process_count() == 1:
+            return jax.device_put(jnp.asarray(a), sharding)
+        a = np.asarray(a)
+        return jax.make_array_from_process_local_data(
+            sharding, self._local_rows(a), a.shape)
+
+    def _fetch(self, tree):
+        if jax.process_count() == 1:
+            return jax.device_get(tree)
+        from jax.experimental import multihost_utils
+        # tiled=True: global (non-fully-addressable) arrays come back as
+        # their full global value on every host, no extra stacking axis
+        return multihost_utils.process_allgather(tree, tiled=True)
+
+    # ------------------------------------------------------------------
     # State init
     # ------------------------------------------------------------------
     def init_state(self, rng: jax.Array, sample_input: np.ndarray) -> TrainState:
@@ -182,11 +245,9 @@ class LocalSGDEngine:
             self.param_specs = self.param_specs_fn(params)
             self._sspec = self._build_state_specs(state)
             return jax.tree_util.tree_map(
-                lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
-                state, self._sspec)
-        sharding = NamedSharding(self.mesh, self._spec)
+                lambda x, s: self._put(x, s), state, self._sspec)
         return jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, sharding), state)
+            lambda x: self._put(x, self._spec), state)
 
     def _build_state_specs(self, state: TrainState):
         """Full-structure PartitionSpec tree for a worker-stacked
@@ -420,15 +481,14 @@ class LocalSGDEngine:
             log.info("compiling round program for shapes %s", key)
             self._round_cache[key] = self._build_round(key)
         xs, ys, ms = self._pack_specs()
-        put = lambda a, s: jax.device_put(
-            jnp.asarray(a), NamedSharding(self.mesh, s))
+        put = self._put
         new_state, metrics = self._round_cache[key](
             state, put(x, xs), put(y, ys), put(m, ms),
             put(xv, xs), put(yv, ys), put(mv, ms))
         # block: keeps at most one collective execution in flight (required
         # on 1-core CPU hosts where pipelined rendezvous can deadlock)
         new_state = jax.block_until_ready(new_state)
-        return new_state, jax.device_get(metrics)
+        return new_state, self._fetch(metrics)
 
     # ------------------------------------------------------------------
     # Streamed rounds: per-chunk host->device feeding (ImageNet scale)
@@ -520,14 +580,13 @@ class LocalSGDEngine:
         cfg = self.cfg
         n = self.n_workers
         xs_spec, ys_spec, ms_spec = self._pack_specs()
-        put = lambda a, s: jax.device_put(
-            jnp.asarray(a), NamedSharding(self.mesh, s))
+        put = self._put
         zeros_like = jax.jit(
             lambda p: jax.tree_util.tree_map(jnp.zeros_like, p))
 
         inner = (state.params, state.batch_stats, state.opt_state, state.rng,
                  zeros_like(state.params))
-        epoch0 = int(jax.device_get(state.lr_epoch)[0])
+        epoch0 = int(jax.device_get(_first_worker_row(state.lr_epoch)))
 
         per_epoch = []  # (train_chunk_ys, val_chunk_sums) device arrays
         for e in range(cfg.epochs_local):
@@ -578,11 +637,11 @@ class LocalSGDEngine:
         E = cfg.epochs_local
         losses, corrects, totals, vls, vcs, vws = ([] for _ in range(6))
         for t_ys, v_sums in per_epoch:
-            l, c, t = zip(*(jax.device_get(ys) for ys in t_ys))
+            l, c, t = zip(*(self._fetch(ys) for ys in t_ys))
             losses.append(np.concatenate(l, 1))     # [N, S]
             corrects.append(np.concatenate(c, 1))
             totals.append(np.concatenate(t, 1))
-            vl, vc, vw = zip(*(jax.device_get(s) for s in v_sums))
+            vl, vc, vw = zip(*(self._fetch(s) for s in v_sums))
             vls.append(np.concatenate(vl, 1).sum(1))  # [N]
             vcs.append(np.concatenate(vc, 1).sum(1))
             vws.append(np.concatenate(vw, 1).sum(1))
@@ -601,7 +660,7 @@ class LocalSGDEngine:
             train_loss=train_loss, train_acc=train_acc,
             val_loss=val_loss, val_acc=val_acc,
             avg_acc=np.broadcast_to(train_acc.mean(0), (n, E)),
-            agg_grad_norm=jax.device_get(agg_grad_norm),
+            agg_grad_norm=self._fetch(agg_grad_norm),
             global_train_loss=tile(train_loss.mean()),
             global_train_acc=tile(train_acc.mean()),
             global_val_loss=tile(val_loss.mean()),

@@ -1,0 +1,51 @@
+"""Worker process for tests/test_multihost.py — NOT a pytest module.
+
+Joins a 2-process JAX coordination-service rendezvous on CPU (4 virtual
+devices per process -> 8-worker global mesh) and runs the real driver
+end-to-end, printing its view of the global metrics as JSON.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=4").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config  # noqa: E402
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global  # noqa: E402
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.mesh import initialize_distributed  # noqa: E402
+
+
+def main() -> None:
+    initialize_distributed()
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+    assert jax.local_device_count() == 4
+
+    ckpt_dir = os.environ.get("MH_CKPT_DIR", "")
+    cfg = Config(model="mlp", dataset="mnist", epochs_global=2,
+                 epochs_local=1, batch_size=8, limit_train_samples=320,
+                 limit_eval_samples=64, compute_dtype="float32",
+                 augment=False, aggregation_by="weights", seed=0,
+                 checkpoint_dir=ckpt_dir,
+                 checkpoint_every=1 if ckpt_dir else 0)
+    res = train_global(cfg, progress=False)
+    print("MHRESULT " + json.dumps({
+        "process": jax.process_index(),
+        "losses": res["global_train_losses"],
+        "val_losses": res["global_val_losses"],
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""REAL multi-host execution: two OS processes, one global 8-worker mesh.
+
+The reference validates its multi-node path by actually launching N
+processes (``torchrun``/``mpirun`` with ``MASTER_ADDR=localhost``,
+``Balanced All-Reduce/main.py:14``); this is the JAX twin — two processes
+join a coordination-service rendezvous on CPU (4 virtual devices each) and
+run the full driver: probe ``process_allgather``, cross-process data feed
+(``make_array_from_process_local_data``), the compiled round with its
+cross-host collectives, replicated metric fetch, measured-wall exchange,
+and the collective multi-host checkpoint save.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "_multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_driver_run(tmp_path):
+    port = _free_port()
+    env_base = {k: v for k, v in os.environ.items()
+                if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    procs = []
+    for pid in range(2):
+        env = dict(
+            env_base,
+            JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            JAX_NUM_PROCESSES="2",
+            JAX_PROCESS_ID=str(pid),
+            MH_CKPT_DIR=str(tmp_path / f"ckpt{pid}"),
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=540)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-host worker timed out")
+        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+        outs.append(out)
+
+    results = {}
+    for out in outs:
+        line = [ln for ln in out.splitlines() if ln.startswith("MHRESULT ")]
+        assert line, out[-2000:]
+        r = json.loads(line[-1][len("MHRESULT "):])
+        results[r["process"]] = r
+
+    assert set(results) == {0, 1}
+    # every process must observe the SAME global metrics (the reference's
+    # all-reduced epoch means), and training must make progress
+    np.testing.assert_allclose(results[0]["losses"], results[1]["losses"],
+                               rtol=1e-6)
+    np.testing.assert_allclose(results[0]["val_losses"],
+                               results[1]["val_losses"], rtol=1e-6)
+    losses = results[0]["losses"]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+    # both hosts wrote the collective checkpoint
+    for pid in range(2):
+        files = os.listdir(tmp_path / f"ckpt{pid}")
+        assert any(f.startswith("ckpt_") for f in files), files
