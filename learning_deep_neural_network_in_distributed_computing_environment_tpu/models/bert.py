@@ -160,8 +160,16 @@ class BertForMLM(nn.Module):
     ep_size: int = 1
     capacity_factor: float = 1.25
 
+    # class marker (not a field): with tp_size > 1 this model's output is
+    # its LOCAL vocab slice and the loss must be vocab-parallel
+    vocab_parallel_head = True
+
     @nn.compact
     def __call__(self, input_ids, *, train: bool = False):
+        if self.tp_size > 1 and self.num_classes % self.tp_size:
+            raise ValueError(
+                f"vocab size {self.num_classes} not divisible by tp_size "
+                f"{self.tp_size} (vocab-parallel MLM head)")
         b, l = input_ids.shape
         tok = nn.Embed(self.num_classes, self.hidden, embedding_init=_init,
                        name="tok_emb")(input_ids)
@@ -195,17 +203,22 @@ class BertForMLM(nn.Module):
                                  ep_size=self.ep_size,
                                  capacity_factor=self.capacity_factor,
                                  name=f"layer{i}")(x, train=train)
-        # untied MLM head: transform + LayerNorm + decode (replicated along
-        # the model axis; vocab-parallel decode is a later optimization).
-        # The head runs in the compute dtype: at bf16 the [*, hidden, vocab]
-        # decode matmul hits the MXU's full bf16 rate and the [B, L, vocab]
+        # untied MLM head: transform + LayerNorm + decode.  The head runs
+        # in the compute dtype: at bf16 the [*, hidden, vocab] decode
+        # matmul hits the MXU's full bf16 rate and the [B, L, vocab]
         # logits cost half the HBM; the loss upcasts to f32 for the
-        # log-softmax either way (train.softmax_cross_entropy)
+        # log-softmax either way (train.softmax_cross_entropy).
+        # Under tensor parallelism the decode is VOCAB-PARALLEL (Megatron):
+        # each shard computes logits for its vocab slice and the engine's
+        # loss uses parallel.tp.vocab_parallel_token_stats — the full
+        # [B, L, V] logits never materialize on one device.
         x = nn.Dense(self.hidden, kernel_init=_init, dtype=self.dtype,
                      name="mlm_dense")(x)
         x = nn.gelu(x, approximate=False)
         x = nn.LayerNorm(epsilon=1e-12, dtype=self.dtype, name="mlm_ln")(x)
-        return nn.Dense(self.num_classes, kernel_init=_init,
+        if self.tp_size > 1:
+            x = copy_to_tp_region(x, self.model_axis)
+        return nn.Dense(self.num_classes // self.tp_size, kernel_init=_init,
                         dtype=self.dtype, name="mlm_decoder")(x)
 
     def _encode_scanned(self, x, train: bool):
@@ -234,8 +247,9 @@ def tp_param_specs(params, axis: str = "model"):
     qkv kernel [H, 3, heads, hd] / bias [3, heads, hd]: heads dim sharded;
     attn out kernel [heads, hd, H] and ffn_out kernel [F, H]: dim 0 sharded
     (row-parallel); ffn_in kernel [H, F] / bias [F]: F sharded (column-
-    parallel); everything else (embeddings, LNs, post-reduce biases, MLM
-    head) replicated.
+    parallel); the MLM decode is vocab-parallel (kernel [H, V]: V sharded
+    — column-parallel over the vocabulary); everything else (embeddings,
+    LNs, post-reduce biases, the MLM transform) replicated.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -250,5 +264,7 @@ def tp_param_specs(params, axis: str = "model"):
             return P(None, axis) if leaf.ndim == 2 else P(axis)
         if "ffn_out" in names:           # kernel [F, H]
             return P(axis, None)
+        if "mlm_decoder" in names:       # kernel [H, V] / bias [V]
+            return P(None, axis) if leaf.ndim == 2 else P(axis)
         return P()
     return jax.tree_util.tree_map_with_path(spec, params)

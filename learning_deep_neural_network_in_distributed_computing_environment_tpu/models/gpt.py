@@ -112,6 +112,10 @@ class GPTForCausalLM(nn.Module):
     pp_size: int = 1
     num_microbatches: int = 0      # 0 => pp_size
 
+    # tied head: logits always cover the FULL vocab (sharding the table
+    # would also shard the input embedding lookup — a later optimization)
+    vocab_parallel_head = False
+
     @nn.compact
     def __call__(self, input_ids, *, train: bool = False):
         b, l = input_ids.shape

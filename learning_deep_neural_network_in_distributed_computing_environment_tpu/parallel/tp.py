@@ -20,8 +20,10 @@ dense model in float64.)
 
 With both markers in place every activation OUTSIDE a region is exact and
 replicated along ``model``, so gradients of replicated parameters
-(embeddings, LayerNorms, the MLM head) come out exact, and gradients of
-sharded parameters stay local.
+(embeddings, LayerNorms, the MLM transform) come out exact, and gradients
+of sharded parameters stay local.  The MLM *decode* is vocab-parallel
+(sharded over ``model``, ``bert.tp_param_specs``) and its loss goes
+through ``vocab_parallel_token_stats`` below.
 
 Outside ``shard_map`` (``axis_name=None``) both markers are identities and
 the same module code runs dense — one parameter structure for both worlds.
@@ -48,3 +50,51 @@ def reduce_from_tp_region(x: jnp.ndarray, axis_name: Optional[str]):
     if axis_name is None:
         return x
     return lax.psum(x, axis_name)
+
+
+def vocab_parallel_token_stats(logits: jnp.ndarray, labels: jnp.ndarray,
+                               batch_mask: jnp.ndarray, axis_name: str):
+    """(ce, weight, correct) over VOCAB-SHARDED logits — the exact twin of
+    ``train.masked_token_stats`` on the gathered logits, without ever
+    materializing the full [.., V] tensor on one shard (the Megatron
+    vocab-parallel cross-entropy).
+
+    ``logits`` [.., V/tp] is this shard's slice of the vocabulary (shard i
+    covers ids [i*V/tp, (i+1)*V/tp)); three scalar-field collectives over
+    ``axis_name`` reconstruct the global log-sum-exp, the logit at the
+    label id, and the global argmax.
+    """
+    v_local = logits.shape[-1]
+    off = lax.axis_index(axis_name) * v_local
+    x = logits.astype(jnp.float32)
+    labels_safe = jnp.maximum(labels, 0)
+
+    # stable global log-sum-exp over the sharded vocab axis; the shift m is
+    # pure stabilization (its gradient cancels analytically), so it is
+    # stop_gradient'ed — pmax has no clean transpose
+    m_local = x.max(axis=-1)
+    # stop_gradient must wrap pmax's INPUT: pmax has no differentiation
+    # rule, so it must never see a tangent-carrying tracer
+    m = lax.pmax(lax.stop_gradient(m_local), axis_name)
+    sumexp = lax.psum(jnp.exp(x - m[..., None]).sum(axis=-1), axis_name)
+    lse = m + jnp.log(sumexp)
+
+    # the label's logit lives on exactly one shard
+    loc = labels_safe - off
+    in_shard = (loc >= 0) & (loc < v_local)
+    picked = jnp.take_along_axis(
+        x, jnp.clip(loc, 0, v_local - 1)[..., None], axis=-1)[..., 0]
+    logit_y = lax.psum(jnp.where(in_shard, picked, 0.0), axis_name)
+    ce = lse - logit_y
+
+    w = batch_mask.reshape(
+        batch_mask.shape + (1,) * (labels.ndim - batch_mask.ndim))
+    w = jnp.broadcast_to(w, labels.shape).astype(jnp.float32) * (labels >= 0)
+
+    # global argmax = smallest id attaining the global max (torch argmax
+    # tie-breaking: first index wins)
+    arg_local = off + x.argmax(axis=-1)
+    pred = lax.pmin(jnp.where(m_local == m, arg_local, jnp.iinfo(jnp.int32).max),
+                    axis_name)
+    correct = ((pred == labels) * w).sum()
+    return ce, w, correct

@@ -165,6 +165,13 @@ class LocalSGDEngine:
         # tensor parallelism: params(single-replica) -> PartitionSpec tree
         # over the 'model' axis (e.g. models.bert.tp_param_specs)
         self.param_specs_fn = param_specs_fn
+        # vocab-parallel head (Megatron): the train model outputs its LOCAL
+        # vocab slice and the loss/accuracy use the sharded-vocab stats
+        tm = self.train_model
+        self.vp_axis = (getattr(tm, "model_axis", None)
+                        if getattr(tm, "tp_size", 1) > 1
+                        and getattr(tm, "vocab_parallel_head", False)
+                        else None)
         self.param_specs = None      # set by init_state
         self._sspec = None           # full TrainState spec tree (TP only)
         # torch.optim.Adam defaults (betas 0.9/0.999, eps 1e-8); LR applied
@@ -287,11 +294,44 @@ class LocalSGDEngine:
     # ------------------------------------------------------------------
     # The round program
     # ------------------------------------------------------------------
+    def _grad_global_norm(self, grads):
+        """Global L2 norm of a gradient pytree whose leaves may be
+        physically sharded over inner mesh axes (TP/PP/EP param specs):
+        sharded leaves' sum-of-squares are psum'ed over their axes, so the
+        result is invariant along every mesh axis (required for the
+        P(data)-only metrics out_spec) and equals the true global norm."""
+        if self.param_specs is None:
+            return optax.global_norm(grads)
+        # group local sum-of-squares by the leaf's sharded-axis set, then
+        # ONE psum per group (not per leaf — keeps the collective count
+        # independent of model depth)
+        groups: dict[tuple, list] = {}
+        for g, spec in zip(jax.tree_util.tree_leaves(grads),
+                           jax.tree_util.tree_leaves(
+                               self.param_specs,
+                               is_leaf=lambda x: isinstance(x, P))):
+            axes = tuple(dict.fromkeys(
+                a for part in spec if part
+                for a in (part if isinstance(part, tuple) else (part,))))
+            groups.setdefault(axes, []).append(
+                jnp.sum(jnp.square(g.astype(jnp.float32))))
+        total = jnp.zeros(())
+        for axes, sumsqs in groups.items():
+            ss = sum(sumsqs)
+            total = total + (lax.psum(ss, axes) if axes else ss)
+        return jnp.sqrt(total)
+
+    def _token_stats(self, out, yb, mb):
+        if self.vp_axis is not None:
+            from .parallel.tp import vocab_parallel_token_stats
+            return vocab_parallel_token_stats(out, yb, mb, self.vp_axis)
+        return masked_token_stats(out, yb, mb)
+
     def _loss_and_metrics(self, params, batch_stats, xb, yb, mb):
         out, mut = self.train_model.apply(
             {"params": params, "batch_stats": batch_stats}, xb, train=True,
             mutable=["batch_stats", "aux"])
-        ce, w, correct = masked_token_stats(out, yb, mb)
+        ce, w, correct = self._token_stats(out, yb, mb)
         if self.seq_axis:
             # sequence-parallel: this device holds one chunk of every
             # sequence.  The loss is the GLOBAL masked mean; returning the
@@ -351,7 +391,7 @@ class LocalSGDEngine:
             out = self.train_model.apply(
                 {"params": params, "batch_stats": batch_stats}, xb,
                 train=False)
-            ce, w, correct = masked_token_stats(out, yb, mb)
+            ce, w, correct = self._token_stats(out, yb, mb)
             sums = ((ce * w).sum(), correct, w.sum())
             if self.seq_axis:
                 sums = lax.psum(sums, self.seq_axis)
@@ -419,7 +459,7 @@ class LocalSGDEngine:
                 agg = comms.aggregate(
                     last_grads, how=cfg.aggregation_type,
                     topology=cfg.topology, local_weight=cfg.local_weight)
-                agg_grad_norm = optax.global_norm(agg)
+                agg_grad_norm = self._grad_global_norm(agg)
 
             # cross-worker global-epoch metric means (trainer.py:152-162)
             metrics = dict(
@@ -561,7 +601,7 @@ class LocalSGDEngine:
                 agg = comms.aggregate(
                     grads, how=cfg.aggregation_type, topology=cfg.topology,
                     local_weight=cfg.local_weight)
-                agg_grad_norm = optax.global_norm(agg)
+                agg_grad_norm = self._grad_global_norm(agg)
             return params, agg_grad_norm
 
         pspec = self._sspec.params if self._sspec is not None else self._spec

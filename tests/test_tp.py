@@ -28,7 +28,7 @@ def tp_mesh(devices):
     return Mesh(np.array(devices[:2]), ("model",))
 
 
-VOCAB = 97
+VOCAB = 96  # divisible by tp_size=2 (vocab-parallel head)
 
 
 def _models():
@@ -51,9 +51,12 @@ class TestTPModule:
         x, _ = _data()
         params = dense.init(jax.random.key(0), x, train=False)["params"]
         specs = tp_param_specs(params, axis="model")
+        # the TP model's output is its LOCAL vocab slice; stitching the
+        # model axis back (out_specs) must reproduce the dense logits
         f = jax.jit(jax.shard_map(
             lambda p, x: tp.apply({"params": p}, x, train=False),
-            mesh=tp_mesh, in_specs=(specs, P()), out_specs=P()))
+            mesh=tp_mesh, in_specs=(specs, P()),
+            out_specs=P(None, None, "model")))
         np.testing.assert_allclose(
             f(params, x), dense.apply({"params": params}, x, train=False),
             atol=1e-4)
@@ -70,8 +73,17 @@ class TestTPModule:
                 return softmax_cross_entropy(logits, y).mean()
             return f
 
+        def tp_loss(p, x, y):
+            # vocab-parallel CE over the sharded-logit output
+            from learning_deep_neural_network_in_distributed_computing_environment_tpu.parallel.tp import (
+                vocab_parallel_token_stats)
+            logits = tp.apply({"params": p}, x, train=False)
+            ce, w, _ = vocab_parallel_token_stats(
+                logits, y, jnp.ones(y.shape[:1], jnp.float32), "model")
+            return (ce * w).sum() / w.sum()
+
         sharded = jax.jit(jax.shard_map(
-            loss(tp), mesh=tp_mesh, in_specs=(specs, P(), P()),
+            tp_loss, mesh=tp_mesh, in_specs=(specs, P(), P()),
             out_specs=P()))
         g = jax.grad(sharded)(params, x, y)
         gref = jax.grad(loss(dense))(params, x, y)
@@ -92,8 +104,60 @@ class TestTPModule:
                                    is_leaf=lambda s: isinstance(s, P)))
         # every encoder layer contributes 4 sharded kernels + 2 sharded
         # biases (qkv kernel+bias, out kernel, ffn_in kernel+bias, ffn_out
-        # kernel); bert_tiny has 2 layers
-        assert sum(flat) == 2 * 6
+        # kernel); bert_tiny has 2 layers; + the vocab-parallel MLM decode
+        # kernel and bias
+        assert sum(flat) == 2 * 6 + 2
+
+
+class TestVocabParallelStats:
+    def test_matches_masked_token_stats(self, devices):
+        """vp CE/accuracy over vocab-sharded logits == the dense stats on
+        the gathered logits, including ignore-index (-1) labels."""
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.parallel.tp import (
+            vocab_parallel_token_stats)
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.train import (
+            masked_token_stats)
+        mesh = Mesh(np.array(devices[:2]), ("model",))
+        rng = np.random.default_rng(3)
+        logits = jnp.asarray(rng.normal(size=(4, 8, VOCAB)), jnp.float32)
+        labels = jnp.asarray(rng.integers(-1, VOCAB, (4, 8)), jnp.int32)
+        mask = jnp.asarray([1.0, 1.0, 0.0, 1.0], jnp.float32)
+
+        f = jax.jit(jax.shard_map(
+            lambda lg: vocab_parallel_token_stats(lg, labels, mask, "model"),
+            mesh=mesh, in_specs=P(None, None, "model"),
+            out_specs=(P(), P(), P())))
+        ce, w, correct = f(logits)
+        ce_ref, w_ref, correct_ref = masked_token_stats(logits, labels, mask)
+        np.testing.assert_allclose(ce, ce_ref, atol=1e-5)
+        np.testing.assert_allclose(w, w_ref)
+        np.testing.assert_allclose(correct, correct_ref)
+
+    def test_grad_matches_dense(self, devices):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.parallel.tp import (
+            vocab_parallel_token_stats)
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.train import (
+            masked_token_stats)
+        mesh = Mesh(np.array(devices[:2]), ("model",))
+        rng = np.random.default_rng(4)
+        logits = jnp.asarray(rng.normal(size=(2, 4, VOCAB)), jnp.float32)
+        labels = jnp.asarray(rng.integers(0, VOCAB, (2, 4)), jnp.int32)
+        mask = jnp.ones((2,), jnp.float32)
+
+        def vp_loss(lg):
+            ce, w, _ = vocab_parallel_token_stats(lg, labels, mask, "model")
+            return (ce * w).sum() / w.sum()
+
+        g = jax.jit(jax.grad(jax.shard_map(
+            vp_loss, mesh=mesh, in_specs=P(None, None, "model"),
+            out_specs=P())))(logits)
+
+        def dense_loss(lg):
+            ce, w, _ = masked_token_stats(lg, labels, mask)
+            return (ce * w).sum() / w.sum()
+
+        np.testing.assert_allclose(g, jax.grad(dense_loss)(logits),
+                                   atol=1e-6)
 
 
 class TestDriverTensorParallel:
@@ -119,6 +183,23 @@ class TestDriverTensorParallel:
         np.testing.assert_allclose(tp["global_train_losses"],
                                    dense["global_train_losses"], rtol=2e-3)
         assert tp["global_train_losses"][-1] < tp["global_train_losses"][0]
+
+    def test_gradients_mode_with_sharded_params(self, devices):
+        """aggregation_by=gradients (the reference default) under TP: the
+        aggregated-gradient norm must psum sharded leaves over 'model'
+        (regression: optax.global_norm of sharded grads varies over the
+        model axis and broke the metrics out_spec replication check)."""
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.mesh import build_mesh
+        mesh = build_mesh({"data": 2, "model": 2}, devices[:4])
+        cfg = Config(model="bert_tiny", dataset="synthetic_mlm",
+                     epochs_global=1, epochs_local=1, batch_size=8,
+                     limit_train_samples=64, limit_eval_samples=16,
+                     compute_dtype="float32", augment=False,
+                     aggregation_by="gradients", seed=7)
+        res = train_global(cfg, mesh=mesh, progress=False)
+        assert np.isfinite(res["global_train_losses"]).all()
 
     def test_requires_attention_model(self, devices):
         from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
