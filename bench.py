@@ -268,7 +268,8 @@ def main() -> None:
     # classic 6/16-channel convs) must not kill the whole benchmark.
     import subprocess
     details = {}
-    jobs = [(k, t) for (k, *_, t) in LADDER] + [("flash_attention", 300)]
+    # flash entry compiles 12 jit variants (2 impls x {fwd, train} x 3 L's)
+    jobs = [(k, t) for (k, *_, t) in LADDER] + [("flash_attention", 480)]
     for key, tmo in jobs:
         t0 = time.perf_counter()
         try:

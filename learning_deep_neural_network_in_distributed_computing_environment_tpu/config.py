@@ -82,7 +82,7 @@ class Config:
     sequence_parallel: str = "none"  # none | ring | all_to_all (for bert)
     attention_impl: str = "dense"    # dense | flash (Pallas kernel; bert)
     pp_microbatches: int = 0         # GPipe microbatches (0 => pipe size)
-    num_experts: int = 0             # >0 => MoE FFN in bert layers
+    num_experts: int = 0             # >0 => MoE FFN in bert/gpt layers
     expert_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01     # load-balance aux loss coefficient
     # Streamed input pipeline: >0 = feed the round in chunks of this many
@@ -199,7 +199,7 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="GPipe microbatches when the mesh has a pipe axis "
                         "(0 = pipe size)")
     p.add_argument("--num_experts", type=int, default=d.num_experts,
-                   help="MoE experts per bert layer (0 = dense FFN); "
+                   help="MoE experts per bert/gpt layer (0 = dense FFN); "
                         "shard with an 'expert' mesh axis")
     p.add_argument("--expert_capacity_factor", type=float,
                    default=d.expert_capacity_factor)

@@ -93,6 +93,56 @@ def _cifar10_synthetic(n_train: int, n_test: int, seed: int):
     return xtr, ytr, xte, yte
 
 
+def _mnist_real(data_dir: str):
+    """MNIST from the standard IDX files (``train-images-idx3-ubyte`` etc.,
+    optionally gzipped) under ``data_dir/MNIST/raw`` or ``data_dir`` —
+    the torchvision on-disk layout, read without torchvision."""
+    import gzip
+    names = {
+        "xtr": "train-images-idx3-ubyte", "ytr": "train-labels-idx1-ubyte",
+        "xte": "t10k-images-idx3-ubyte", "yte": "t10k-labels-idx1-ubyte",
+    }
+
+    def find(name):
+        for base in (os.path.join(data_dir, "MNIST", "raw"), data_dir):
+            for suffix in ("", ".gz"):
+                p = os.path.join(base, name + suffix)
+                if os.path.isfile(p):
+                    return p
+        return None
+
+    paths = {k: find(n) for k, n in names.items()}
+    if any(p is None for p in paths.values()):
+        return None
+
+    def read_idx(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            raw = f.read()
+        if len(raw) < 4:
+            raise ValueError(f"truncated IDX header in {path}")
+        magic = raw[2]  # dtype code (0x08 = u8)
+        ndim = raw[3]
+        if magic != 0x08:
+            raise ValueError(f"unsupported IDX dtype 0x{magic:02x} in {path}")
+        if len(raw) < 4 + 4 * ndim:
+            raise ValueError(f"truncated IDX dimension table in {path}")
+        dims = [int.from_bytes(raw[4 + 4 * i:8 + 4 * i], "big")
+                for i in range(ndim)]
+        expect = 4 + 4 * ndim + int(np.prod(dims))
+        if len(raw) != expect:
+            raise ValueError(f"IDX payload size mismatch in {path}: "
+                             f"{len(raw)} bytes, expected {expect}")
+        return np.frombuffer(raw, np.uint8,
+                             offset=4 + 4 * ndim).reshape(dims)
+
+    xtr = read_idx(paths["xtr"]).astype(np.float32)[..., None] / 255.0
+    xte = read_idx(paths["xte"]).astype(np.float32)[..., None] / 255.0
+    ytr = read_idx(paths["ytr"]).astype(np.int32)
+    yte = read_idx(paths["yte"]).astype(np.int32)
+    return xtr, ytr, xte, yte
+
+
 def _mnist_synthetic(n_train: int, n_test: int, seed: int):
     """Learnable 10-class 28x28x1 data (digit-like stroke templates)."""
     rng = np.random.default_rng(seed)
@@ -182,9 +232,13 @@ def load_dataset(name: str, data_dir: str = "data", seed: int = 0,
                 min(limit_test or 10_000, 10_000), seed)
         ncls = 10
     elif name == "mnist":
-        xtr, ytr, xte, yte = _mnist_synthetic(
-            min(limit_train or 60_000, 60_000),
-            min(limit_test or 10_000, 10_000), seed)
+        real = _mnist_real(data_dir)
+        if real is not None:
+            xtr, ytr, xte, yte = real
+        else:
+            xtr, ytr, xte, yte = _mnist_synthetic(
+                min(limit_train or 60_000, 60_000),
+                min(limit_test or 10_000, 10_000), seed)
         ncls = 10
     elif name == "imagenet":
         # synthetic ImageNet-shaped data (224x224x3, 1000 classes), sized for

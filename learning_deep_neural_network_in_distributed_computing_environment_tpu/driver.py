@@ -149,9 +149,9 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     if cfg.num_experts > 0:
         # MoE FFN (models/moe.py); with an 'expert' mesh axis the stacked
         # expert weights shard over it (expert parallelism)
-        if not cfg.model.startswith("bert"):
+        if not is_attention_model(cfg.model):
             raise ValueError(
-                f"--num_experts applies to attention models (bert_*); "
+                f"--num_experts applies to attention models (bert_*/gpt_*); "
                 f"got --model {cfg.model}")
         if (pp > 1 or int(mesh.shape.get(MODEL_AXIS, 1)) > 1
                 or cfg.sequence_parallel != "none"):

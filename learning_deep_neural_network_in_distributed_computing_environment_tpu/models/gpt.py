@@ -34,7 +34,10 @@ _init = nn.initializers.normal(stddev=0.02)
 
 
 class GPTBlock(nn.Module):
-    """Pre-LN decoder block: x + attn(ln1(x)); x + ffn(ln2(x))."""
+    """Pre-LN decoder block: x + attn(ln1(x)); x + ffn(ln2(x)).
+
+    ``num_experts > 0`` swaps the dense FFN for the Switch-MoE FFN
+    (``models/moe.py``), shardable over an ``expert`` mesh axis."""
 
     num_heads: int
     ffn_dim: int                   # GLOBAL FFN width
@@ -43,6 +46,10 @@ class GPTBlock(nn.Module):
     axis_name: Optional[str] = None
     tp_size: int = 1
     model_axis: Optional[str] = None
+    num_experts: int = 0
+    expert_axis: Optional[str] = None
+    ep_size: int = 1
+    capacity_factor: float = 1.25
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -54,15 +61,22 @@ class GPTBlock(nn.Module):
                           name="attn")(h)
         x = x + a
         f = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln2")(x)
-        f = copy_to_tp_region(f, self.model_axis)
-        f = nn.Dense(self.ffn_dim // self.tp_size, kernel_init=_init,
-                     dtype=self.dtype, name="ffn_in")(f)
-        f = nn.gelu(f, approximate=True)
-        f = nn.Dense(x.shape[-1], kernel_init=_init, use_bias=False,
-                     dtype=self.dtype, name="ffn_out")(f)
-        f = reduce_from_tp_region(f, self.model_axis)
-        f = f + self.param("ffn_bias", nn.initializers.zeros,
-                           (x.shape[-1],)).astype(f.dtype)
+        if self.num_experts:
+            from .moe import MoEFFN
+            f = MoEFFN(self.num_experts, self.ffn_dim,
+                       capacity_factor=self.capacity_factor,
+                       dtype=self.dtype, expert_axis=self.expert_axis,
+                       ep_size=self.ep_size, name="moe")(f, train=train)
+        else:
+            f = copy_to_tp_region(f, self.model_axis)
+            f = nn.Dense(self.ffn_dim // self.tp_size, kernel_init=_init,
+                         dtype=self.dtype, name="ffn_in")(f)
+            f = nn.gelu(f, approximate=True)
+            f = nn.Dense(x.shape[-1], kernel_init=_init, use_bias=False,
+                         dtype=self.dtype, name="ffn_out")(f)
+            f = reduce_from_tp_region(f, self.model_axis)
+            f = f + self.param("ffn_bias", nn.initializers.zeros,
+                               (x.shape[-1],)).astype(f.dtype)
         return x + f
 
 
@@ -111,6 +125,10 @@ class GPTForCausalLM(nn.Module):
     pipeline_axis: Optional[str] = None
     pp_size: int = 1
     num_microbatches: int = 0      # 0 => pp_size
+    num_experts: int = 0           # >0 => Switch-MoE FFN in every block
+    expert_axis: Optional[str] = None
+    ep_size: int = 1
+    capacity_factor: float = 1.25
 
     # tied head: logits always cover the FULL vocab (sharding the table
     # would also shard the input embedding lookup — a later optimization)
@@ -133,6 +151,11 @@ class GPTForCausalLM(nn.Module):
                        dtype=self.dtype, name="pos_emb")(pos_ids[None, :])
         x = jnp.asarray(tok + pos, self.dtype)
         if self.scan_layers:
+            if self.num_experts:
+                raise NotImplementedError(
+                    "MoE blocks do not yet compose with scan_layers/"
+                    "pipeline parallelism (the sown aux loss would need "
+                    "lifting through nn.scan)")
             x = self._decode_scanned(x, train)
         else:
             for i in range(self.num_layers):
@@ -140,6 +163,10 @@ class GPTForCausalLM(nn.Module):
                              attention_impl=self.attention_impl,
                              axis_name=self.axis_name, tp_size=self.tp_size,
                              model_axis=self.model_axis,
+                             num_experts=self.num_experts,
+                             expert_axis=self.expert_axis,
+                             ep_size=self.ep_size,
+                             capacity_factor=self.capacity_factor,
                              name=f"layer{i}")(x, train=train)
         x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln_f")(x)
         # tied LM head: logits = x @ tok_emb^T (shares the embedding table)

@@ -112,7 +112,8 @@ class TestGPT:
         ({"data": 2, "model": 2}, {}),
         ({"data": 2, "seq": 2}, {"sequence_parallel": "ring"}),
         ({"data": 2, "pipe": 2}, {}),
-    ], ids=["tensor", "seq_ring", "pipeline"])
+        ({"data": 2, "expert": 2}, {"num_experts": 4}),
+    ], ids=["tensor", "seq_ring", "pipeline", "expert_moe"])
     def test_gpt_tiny_parallel_modes(self, axes, extra, devices):
         from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
         from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global

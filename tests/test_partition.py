@@ -149,3 +149,50 @@ class TestSources:
                                 limit_train=1000, limit_test=10)
         tr, va = train_val_split(train, 0.2, seed=0)
         assert len(tr) == 800 and len(va) == 200
+
+
+class TestMnistIdxLoader:
+    def test_reads_idx_files_and_falls_back(self, tmp_path):
+        """The real-MNIST backend parses standard IDX files (written here
+        byte-for-byte per the spec) and load_dataset falls back to
+        synthetic when they are absent."""
+        import gzip
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.data.sources import (
+            _mnist_real, load_dataset)
+
+        raw = tmp_path / "MNIST" / "raw"
+        raw.mkdir(parents=True)
+        rng = np.random.default_rng(0)
+
+        def write_idx(name, arr, gz=False):
+            dims = b"".join(int(d).to_bytes(4, "big") for d in arr.shape)
+            payload = (b"\x00\x00\x08" + bytes([arr.ndim]) + dims
+                       + arr.astype(np.uint8).tobytes())
+            p = raw / (name + (".gz" if gz else ""))
+            with (gzip.open(p, "wb") if gz else open(p, "wb")) as f:
+                f.write(payload)
+
+        xtr = rng.integers(0, 256, (6, 28, 28))
+        ytr = rng.integers(0, 10, (6,))
+        xte = rng.integers(0, 256, (4, 28, 28))
+        yte = rng.integers(0, 10, (4,))
+        write_idx("train-images-idx3-ubyte", xtr)
+        write_idx("train-labels-idx1-ubyte", ytr)
+        write_idx("t10k-images-idx3-ubyte", xte, gz=True)  # mixed gz/raw
+        write_idx("t10k-labels-idx1-ubyte", yte, gz=True)
+
+        got = _mnist_real(str(tmp_path))
+        assert got is not None
+        gxtr, gytr, gxte, gyte = got
+        np.testing.assert_allclose(gxtr[..., 0] * 255.0, xtr, atol=1e-4)
+        np.testing.assert_array_equal(gytr, ytr)
+        np.testing.assert_allclose(gxte[..., 0] * 255.0, xte, atol=1e-4)
+        np.testing.assert_array_equal(gyte, yte)
+
+        train, test = load_dataset("mnist", data_dir=str(tmp_path))
+        assert len(train) == 6 and len(test) == 4
+
+        # absent files -> synthetic fallback with the requested limits
+        train, test = load_dataset("mnist", data_dir=str(tmp_path / "nope"),
+                                   limit_train=32, limit_test=8)
+        assert len(train) == 32 and len(test) == 8
