@@ -75,3 +75,19 @@ class TestStreamedRound:
             self._cfg(data_mode="disbalanced", stream_chunk_steps=3),
             mesh=mesh8, progress=False)
         assert np.isfinite(res["global_train_losses"]).all()
+
+    def test_streamed_with_tensor_parallel(self, devices):
+        """The streamed round must compose with TP param specs (the inner
+        carry uses the sharded state specs) and match the packed TP round."""
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.mesh import build_mesh
+        kw = dict(model="bert_tiny", dataset="synthetic_mlm",
+                  epochs_global=2, epochs_local=1, batch_size=8,
+                  limit_train_samples=128, limit_eval_samples=32,
+                  compute_dtype="float32", augment=False,
+                  aggregation_by="weights", seed=11)
+        mesh = build_mesh({"data": 2, "model": 2}, devices[:4])
+        packed = train_global(Config(**kw), mesh=mesh, progress=False)
+        streamed = train_global(Config(stream_chunk_steps=2, **kw),
+                                mesh=mesh, progress=False)
+        np.testing.assert_allclose(streamed["global_train_losses"],
+                                   packed["global_train_losses"], rtol=1e-5)
