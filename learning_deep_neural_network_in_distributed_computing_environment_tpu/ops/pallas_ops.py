@@ -35,8 +35,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .attention import NEG_INF
 
-BQ = 256  # query block (MXU-aligned)
-BK = 512  # key/value block
+BQ = 512   # query block (MXU-aligned)
+BK = 1024  # key/value block
+# (block sizes swept on v5e: (512, 1024) beats (256, 512) at every L —
+# 6.2 vs 7.0 ms at L=2048, 8.5 vs 11.7 ms at L=8192 forward; the larger
+# K/V block halves the online-softmax rescale traffic per element)
 
 
 def _interpret() -> bool:
