@@ -184,6 +184,30 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         from .models.bert import tp_param_specs
         train_kw.update(tp_size=tp, model_axis=MODEL_AXIS)
         param_specs_fn = partial(tp_param_specs, axis=MODEL_AXIS)
+    from .mesh import FSDP_AXIS
+    fsdp = int(mesh.shape.get(FSDP_AXIS, 1))
+    if fsdp > 1:
+        # ZeRO-3 / FSDP (parallel/fsdp.py): params + Adam moments sharded
+        # over 'fsdp', each worker's batch split over it, params
+        # all-gathered per step (gradients reduce-scattered by autodiff).
+        # Works for every model family — the model code never sees shards.
+        if (pp > 1 or tp > 1 or ep > 1 or cfg.num_experts > 0
+                or cfg.sequence_parallel != "none"):
+            # MoE even without an expert axis: per-sub-batch routing would
+            # change capacity semantics and the psum over fsdp would scale
+            # the aux loss by the axis size (same reason as the MoE guard
+            # above)
+            raise NotImplementedError(
+                f"a '{FSDP_AXIS}' mesh axis does not yet compose with "
+                "tensor/pipeline/sequence/expert parallelism or MoE")
+        if cfg.batch_size % fsdp:
+            raise ValueError(
+                f"--batch_size {cfg.batch_size} must be divisible by the "
+                f"'{FSDP_AXIS}' axis size {fsdp} (the batch splits over it)")
+        from functools import partial
+        from .parallel.fsdp import fsdp_param_specs
+        param_specs_fn = partial(fsdp_param_specs, axis=FSDP_AXIS,
+                                 axis_size=fsdp)
     if cfg.sequence_parallel != "none":
         if cfg.attention_impl != "dense":
             raise ValueError(

@@ -30,6 +30,7 @@ MODEL_AXIS = "model"
 PIPE_AXIS = "pipe"
 SEQ_AXIS = "seq"
 EXPERT_AXIS = "expert"
+FSDP_AXIS = "fsdp"
 
 
 def initialize_distributed() -> None:

@@ -143,6 +143,22 @@ def _tree_where(pred, a: PyTree, b: PyTree) -> PyTree:
         lambda x, y: jnp.where(pred, x, y), a, b)
 
 
+def _zeros_like_varying(tree: PyTree) -> PyTree:
+    """``zeros_like`` whose varying-axes type matches each source leaf.
+
+    Scan carries under ``shard_map`` must type-match their body outputs
+    (parallel/sp.py's accumulator note); a plain ``jnp.zeros_like`` is
+    axis-invariant while fsdp-sharded gradient leaves vary over the fsdp
+    axis."""
+    def z(x):
+        zz = jnp.zeros_like(x)
+        want = set(getattr(jax.typeof(x), "vma", ()))
+        have = set(getattr(jax.typeof(zz), "vma", ()))
+        missing = tuple(sorted(want - have))
+        return lax.pcast(zz, missing, to="varying") if missing else zz
+    return jax.tree_util.tree_map(z, tree)
+
+
 class LocalSGDEngine:
     """Builds and caches the jitted round program for one (model, mesh,
     config) triple."""
@@ -157,11 +173,15 @@ class LocalSGDEngine:
         self.mesh = mesh
         self.cfg = cfg
         self.n_workers = mesh.shape[DATA_AXIS]
-        from .mesh import SEQ_AXIS
+        from .mesh import FSDP_AXIS, SEQ_AXIS
         self.seq_axis = (
             SEQ_AXIS if (cfg.sequence_parallel != "none"
                          and SEQ_AXIS in mesh.shape
                          and mesh.shape[SEQ_AXIS] > 1) else None)
+        # ZeRO-3 / FSDP (parallel/fsdp.py): params + Adam moments sharded
+        # over 'fsdp', batch split over it, params all-gathered per step
+        self.fsdp_axis = (
+            FSDP_AXIS if int(mesh.shape.get(FSDP_AXIS, 1)) > 1 else None)
         # tensor parallelism: params(single-replica) -> PartitionSpec tree
         # over the 'model' axis (e.g. models.bert.tp_param_specs)
         self.param_specs_fn = param_specs_fn
@@ -328,19 +348,28 @@ class LocalSGDEngine:
         return masked_token_stats(out, yb, mb)
 
     def _loss_and_metrics(self, params, batch_stats, xb, yb, mb):
+        if self.fsdp_axis:
+            # ZeRO-3: shards -> full params just-in-time; grad of this
+            # all_gather is reduce-scatter, so each device's gradient tree
+            # comes back already sharded (parallel/fsdp.py)
+            from .parallel.fsdp import gather_params
+            params = gather_params(params, self.param_specs, self.fsdp_axis)
         out, mut = self.train_model.apply(
             {"params": params, "batch_stats": batch_stats}, xb, train=True,
             mutable=["batch_stats", "aux"])
         ce, w, correct = self._token_stats(out, yb, mb)
-        if self.seq_axis:
-            # sequence-parallel: this device holds one chunk of every
-            # sequence.  The loss is the GLOBAL masked mean; returning the
-            # local numerator over the global denominator makes
-            # grad(loss_partial), psum'ed over seq, equal grad(global loss).
-            denom = jnp.maximum(lax.psum(w.sum(), self.seq_axis), 1.0)
+        part_axis = self.seq_axis or self.fsdp_axis
+        if part_axis:
+            # the batch is partial on this device: under seq parallelism it
+            # holds one chunk of every sequence, under FSDP a slice of the
+            # worker's batch.  The loss is the GLOBAL masked mean; returning
+            # the local numerator over the global denominator makes the
+            # cross-device gradient reduction (psum over seq /
+            # reduce-scatter over fsdp) equal grad(global loss).
+            denom = jnp.maximum(lax.psum(w.sum(), part_axis), 1.0)
             loss = (ce * w).sum() / denom
-            correct = lax.psum(correct, self.seq_axis)
-            total = lax.psum(w.sum(), self.seq_axis)
+            correct = lax.psum(correct, part_axis)
+            total = lax.psum(w.sum(), part_axis)
         else:
             loss = _masked_mean(ce, w)
             total = w.sum()
@@ -348,7 +377,14 @@ class LocalSGDEngine:
         aux = jax.tree_util.tree_leaves(mut.get("aux", {}))
         if aux:
             loss = loss + self.cfg.moe_aux_weight * sum(aux)
-        return loss, (mut.get("batch_stats", batch_stats), correct, total)
+        new_bs = mut.get("batch_stats", batch_stats)
+        if self.fsdp_axis and jax.tree_util.tree_leaves(new_bs):
+            # BatchNorm under FSDP: each device normalized its sub-batch
+            # with its own statistics (standard DP BatchNorm); the running
+            # stats are averaged so the stored tree stays replicated along
+            # the fsdp axis
+            new_bs = lax.pmean(new_bs, self.fsdp_axis)
+        return loss, (new_bs, correct, total)
 
     def _make_step_fns(self, augment: bool):
         """The shared per-batch bodies: one SGD step and one eval step.
@@ -361,6 +397,12 @@ class LocalSGDEngine:
             rng, k = jax.random.split(jax.random.wrap_key_data(rng))
             rng = jax.random.key_data(rng)
             if augment:
+                if self.fsdp_axis:
+                    # the per-worker key is replicated along fsdp while the
+                    # batch is split over it: decorrelate so each device's
+                    # slice gets independent per-image draws
+                    k = jax.random.fold_in(
+                        k, lax.axis_index(self.fsdp_axis))
                 xb = augment_batch(k, xb)
             (loss, (new_bs, correct, total)), grads = jax.value_and_grad(
                 self._loss_and_metrics, has_aux=True)(
@@ -370,6 +412,15 @@ class LocalSGDEngine:
                 # Adam update below) stay replicated along seq
                 grads = lax.psum(grads, self.seq_axis)
                 loss = lax.psum(loss, self.seq_axis)
+            elif self.fsdp_axis:
+                # sharded leaves' grads arrived reduce-scattered (all_gather
+                # transpose); replicated leaves still need their per-device
+                # partials summed.  The loss metric combines the same way:
+                # global mean = sum of local numerators / psum'd denominator.
+                from .parallel.fsdp import reduce_replicated_grads
+                grads = reduce_replicated_grads(grads, self.param_specs,
+                                                self.fsdp_axis)
+                loss = lax.psum(loss, self.fsdp_axis)
             updates, new_opt = self.tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(
                 params, jax.tree_util.tree_map(lambda u: -lr * u, updates))
@@ -386,6 +437,9 @@ class LocalSGDEngine:
                     (loss, correct, total))
 
         def eval_step(carry, inp):
+            # NOTE: under FSDP the carry must hold FULL params — callers
+            # gather once before the scan (params are loop-invariant during
+            # eval; a per-batch all_gather would be pure waste)
             params, batch_stats = carry
             xb, yb, mb = inp
             out = self.train_model.apply(
@@ -393,8 +447,9 @@ class LocalSGDEngine:
                 train=False)
             ce, w, correct = self._token_stats(out, yb, mb)
             sums = ((ce * w).sum(), correct, w.sum())
-            if self.seq_axis:
-                sums = lax.psum(sums, self.seq_axis)
+            part_axis = self.seq_axis or self.fsdp_axis
+            if part_axis:
+                sums = lax.psum(sums, part_axis)
             return carry, sums
 
         return train_step, eval_step
@@ -407,7 +462,7 @@ class LocalSGDEngine:
 
         def per_worker(state: TrainState, x, y, m, xv, yv, mv):
             """One worker's round.  x:[S,B,...] y,m:[S,B]; val likewise."""
-            zero_grads = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+            zero_grads = _zeros_like_varying(state.params)
 
             def local_epoch(carry, _):
                 params, batch_stats, opt_state, lr_epoch, rng, _ = carry
@@ -424,9 +479,14 @@ class LocalSGDEngine:
                 train_acc = 100.0 * corrects.sum() / jnp.maximum(
                     totals.sum(), 1.0)
                 # validation on the worker's own val shard every local epoch
-                # (trainer.py:105-107)
+                # (trainer.py:105-107); FSDP: one gather for the whole scan
+                eval_params = params
+                if self.fsdp_axis:
+                    from .parallel.fsdp import gather_params
+                    eval_params = gather_params(
+                        params, self.param_specs, self.fsdp_axis)
                 _, (vls, vcs, vts) = lax.scan(
-                    eval_step, (params, batch_stats), (xv, yv, mv))
+                    eval_step, (eval_params, batch_stats), (xv, yv, mv))
                 val_loss = vls.sum() / jnp.maximum(vts.sum(), 1.0)
                 val_acc = 100.0 * vcs.sum() / jnp.maximum(vts.sum(), 1.0)
                 # cross-worker mean accuracy per local epoch (trainer.py:50-53)
@@ -497,10 +557,13 @@ class LocalSGDEngine:
         """(x, y, m) PartitionSpecs for one pack.  Token tasks under
         sequence parallelism additionally shard the sequence dim of x
         [N,S,B,L] and y [N,S,B,L] over the seq axis; the per-example mask m
-        [N,S,B] stays data-only."""
+        [N,S,B] stays data-only.  Under FSDP the batch dim (index 2) of all
+        three shards over the fsdp axis — it is an inner data axis."""
         if self.seq_axis:
             tok = P(DATA_AXIS, None, None, self.seq_axis)
             return (tok, tok, self._spec)
+        if self.fsdp_axis:
+            return (P(DATA_AXIS, None, self.fsdp_axis),) * 3
         return (self._spec,) * 3
 
     def _inner_specs(self):
@@ -577,6 +640,10 @@ class LocalSGDEngine:
         _, eval_step = self._make_step_fns(False)
 
         def per_worker(params, batch_stats, x, y, m):
+            if self.fsdp_axis:
+                from .parallel.fsdp import gather_params
+                params = gather_params(params, self.param_specs,
+                                       self.fsdp_axis)
             _, sums = lax.scan(eval_step, (params, batch_stats), (x, y, m))
             return sums  # (ce_sum, correct, w_sum), each [C]
 

@@ -1,0 +1,176 @@
+"""ZeRO-3 / FSDP sharded data parallelism (``parallel/fsdp.py``).
+
+Beyond-reference capability (SURVEY.md 2.3 lists the ZeRO/FSDP row as
+absent — the reference keeps a full replica + per-worker Adam,
+``Balanced All-Reduce/main.py:53``).  Correctness is asserted three ways:
+spec/gather unit math, physical sharding of params AND Adam moments in the
+initialized TrainState, and end-to-end numerics on a (data=2, fsdp=2) mesh
+against the plain data=2 run with identical seed/config.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.mesh import build_mesh
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import get_model
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.parallel.fsdp import (
+    MIN_SHARD_ELEMS,
+    fsdp_param_specs,
+    gather_params,
+)
+
+
+class TestSpecsAndGather:
+    def _params(self):
+        model = get_model("mlp", num_classes=10)
+        x = jnp.zeros((2, 28, 28, 1), jnp.float32)
+        return model.init(jax.random.key(0), x, train=False)["params"]
+
+    def test_large_leaves_shard_small_replicate(self):
+        params = self._params()
+        specs = fsdp_param_specs(params, axis="fsdp", axis_size=2)
+        for (path, leaf), (_, spec) in zip(
+                jax.tree_util.tree_leaves_with_path(params),
+                jax.tree_util.tree_leaves_with_path(
+                    specs, is_leaf=lambda s: isinstance(s, P))):
+            if leaf.size >= MIN_SHARD_ELEMS and any(
+                    s % 2 == 0 for s in leaf.shape):
+                assert "fsdp" in spec, jax.tree_util.keystr(path)
+                d = spec.index("fsdp")
+                assert leaf.shape[d] % 2 == 0
+            else:
+                assert "fsdp" not in spec, jax.tree_util.keystr(path)
+        # the MLP's big input kernel must actually be sharded (the point)
+        flat = jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(lambda s: "fsdp" in s, specs,
+                                   is_leaf=lambda s: isinstance(s, P)))
+        assert sum(flat) >= 1
+
+    def test_gather_roundtrip(self, devices):
+        params = self._params()
+        specs = fsdp_param_specs(params, axis="fsdp", axis_size=2)
+        mesh = Mesh(np.array(devices[:2]), ("fsdp",))
+        # all_gather output is TYPED varying (shard_map can't statically
+        # prove replication), so the replication check is off for this
+        # out_specs=P() roundtrip; numerics below prove actual equality
+        f = jax.jit(jax.shard_map(
+            lambda p: gather_params(p, specs, "fsdp"),
+            mesh=mesh, in_specs=(specs,),
+            out_specs=jax.tree_util.tree_map(
+                lambda _: P(), specs, is_leaf=lambda s: isinstance(s, P)),
+            check_vma=False))
+        out = f(params)
+        jax.tree_util.tree_map(np.testing.assert_array_equal, out, params)
+
+
+def _run(devices, mesh_axes, model="mlp", dataset="mnist", **kw):
+    mesh = build_mesh(mesh_axes, devices)
+    cfg = Config(model=model, dataset=dataset, epochs_global=2,
+                 epochs_local=1, batch_size=8, limit_train_samples=128,
+                 limit_eval_samples=32, compute_dtype="float32",
+                 augment=False, aggregation_by="weights", seed=11, **kw)
+    return train_global(cfg, mesh=mesh, progress=False)
+
+
+class TestDriverFSDP:
+    def test_matches_plain_dp_mlp(self, devices):
+        plain = _run(devices[:2], {"data": 2})
+        fsdp = _run(devices[:4], {"data": 2, "fsdp": 2})
+        np.testing.assert_allclose(fsdp["global_train_losses"],
+                                   plain["global_train_losses"], rtol=2e-4)
+        np.testing.assert_allclose(fsdp["global_val_losses"],
+                                   plain["global_val_losses"], rtol=2e-4)
+        assert fsdp["global_train_losses"][-1] < fsdp["global_train_losses"][0]
+
+    def test_matches_plain_dp_bert(self, devices):
+        plain = _run(devices[:2], {"data": 2}, model="bert_tiny",
+                     dataset="synthetic_mlm")
+        fsdp = _run(devices[:4], {"data": 2, "fsdp": 2}, model="bert_tiny",
+                    dataset="synthetic_mlm")
+        np.testing.assert_allclose(fsdp["global_train_losses"],
+                                   plain["global_train_losses"], rtol=2e-3)
+
+    def test_batchnorm_model_runs(self, devices):
+        """BN under FSDP: per-device sub-batch statistics, pmean'd running
+        stats (engine-level, width-8 CNN so CPU stays fast)."""
+        from functools import partial
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.train import LocalSGDEngine
+        mesh = build_mesh({"data": 2, "fsdp": 2}, devices[:4])
+        cfg = Config(epochs_local=1, batch_size=4, compute_dtype="float32",
+                     augment=False, aggregation_by="weights")
+        model = get_model("enhanced_cnn", num_classes=10, width=8)
+        eng = LocalSGDEngine(
+            model, mesh, cfg,
+            param_specs_fn=partial(fsdp_param_specs, axis="fsdp",
+                                   axis_size=2))
+        rng = np.random.default_rng(0)
+        n, steps, b = 2, 2, cfg.batch_size
+        x = rng.normal(size=(n, steps, b, 32, 32, 3)).astype(np.float32)
+        y = rng.integers(0, 10, (n, steps, b)).astype(np.int32)
+        m = np.ones((n, steps, b), np.float32)
+        state = eng.init_state(jax.random.key(0), x[0, 0])
+        state, mx = eng.round(state, (x, y, m), (x, y, m))
+        assert np.isfinite(mx["train_loss"]).all()
+        # running stats stayed replicated along fsdp (pmean'd)
+        bs_leaf = jax.tree_util.tree_leaves(state.batch_stats)[0]
+        assert "fsdp" not in str(bs_leaf.sharding.spec)
+
+    def test_state_is_physically_sharded(self, devices):
+        """Params AND Adam moments shard over fsdp — the ZeRO-3 memory
+        claim — while small leaves stay replicated."""
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.train import LocalSGDEngine
+        from functools import partial
+        mesh = build_mesh({"data": 2, "fsdp": 2}, devices[:4])
+        model = get_model("mlp", num_classes=10)
+        cfg = Config(model="mlp", batch_size=8, compute_dtype="float32",
+                     augment=False)
+        eng = LocalSGDEngine(
+            model, mesh, cfg,
+            param_specs_fn=partial(fsdp_param_specs, axis="fsdp",
+                                   axis_size=2))
+        state = eng.init_state(jax.random.key(0),
+                               np.zeros((8, 28, 28, 1), np.float32))
+
+        def sharded_axes(tree):
+            return {
+                jax.tree_util.keystr(path): leaf.sharding.spec
+                for path, leaf in jax.tree_util.tree_leaves_with_path(tree)}
+
+        pspecs = sharded_axes(state.params)
+        assert any("fsdp" in s for s in pspecs.values())
+        # Adam mu/nu mirror the param sharding
+        mspecs = sharded_axes(state.opt_state)
+        assert any("fsdp" in s for s in mspecs.values())
+
+    def test_augment_runs_decorrelated(self, devices):
+        """augment=True under FSDP: the per-worker key is folded with the
+        fsdp axis index (code-review r2 finding: replicated key + split
+        batch = duplicated per-image draws across devices)."""
+        mesh = build_mesh({"data": 2, "fsdp": 2}, devices[:4])
+        cfg = Config(model="lenet5", dataset="mnist", epochs_global=1,
+                     epochs_local=1, batch_size=8, limit_train_samples=64,
+                     limit_eval_samples=16, compute_dtype="float32",
+                     augment=True, aggregation_by="weights", seed=12)
+        res = train_global(cfg, mesh=mesh, progress=False)
+        assert np.isfinite(res["global_train_losses"]).all()
+
+    def test_batch_divisibility_error(self, devices):
+        mesh = build_mesh({"data": 2, "fsdp": 2}, devices[:4])
+        cfg = Config(model="mlp", dataset="mnist", batch_size=7,
+                     limit_train_samples=64, limit_eval_samples=16,
+                     augment=False)
+        with pytest.raises(ValueError, match="divisible"):
+            train_global(cfg, mesh=mesh, progress=False)
+
+    def test_no_composition_with_tp(self, devices):
+        mesh = build_mesh({"data": 1, "fsdp": 2, "model": 2}, devices[:4])
+        cfg = Config(model="bert_tiny", dataset="synthetic_mlm",
+                     batch_size=8, limit_train_samples=64,
+                     limit_eval_samples=16, augment=False)
+        with pytest.raises(NotImplementedError, match="compose"):
+            train_global(cfg, mesh=mesh, progress=False)
