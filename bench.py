@@ -53,8 +53,13 @@ def jax_fetch(state):
 
 
 def measure_model(name: str, input_shape, batch: int, steps: int,
-                  num_classes: int, token_task: bool = False) -> dict:
-    """{img_per_sec, step_ms, flops_per_step, mfu_pct} for one ladder entry."""
+                  num_classes: int, token_task: bool = False,
+                  **model_kw) -> dict:
+    """{img_per_sec, step_ms, flops_per_step, mfu_pct, hbm_gb_per_step,
+    hbm_roofline_frac} for one ladder entry.  ``hbm_roofline_frac`` is the
+    fraction of the step's HBM-bandwidth bound actually achieved (1.0 =
+    the step IS memory-bound and running at the roofline — e.g. ResNet-50,
+    whose MFU ceiling is set by bytes, not FLOPs)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -64,7 +69,8 @@ def measure_model(name: str, input_shape, batch: int, steps: int,
     from learning_deep_neural_network_in_distributed_computing_environment_tpu.train import softmax_cross_entropy
     from learning_deep_neural_network_in_distributed_computing_environment_tpu.utils import mfu
 
-    model = get_model(name, num_classes=num_classes, dtype=jnp.bfloat16)
+    model = get_model(name, num_classes=num_classes, dtype=jnp.bfloat16,
+                      **model_kw)
     rng = np.random.default_rng(0)
     if token_task:
         x = jnp.asarray(rng.integers(2, num_classes, (batch, *input_shape)),
@@ -111,18 +117,27 @@ def measure_model(name: str, input_shape, batch: int, steps: int,
         analysis = analysis[0] if analysis else None
     flops = float(analysis["flops"]) if analysis and analysis.get("flops") \
         else None
+    hbm_bytes = (float(analysis["bytes accessed"])
+                 if analysis and analysis.get("bytes accessed") else None)
     step = compiled
     state = step(state)  # warm
     jax_fetch(state)
     sps = _chain_rate(step, state, steps)
     step_s = 1.0 / sps
     m = mfu(flops, step_s)
-    return {
+    out = {
         "img_per_sec": round(batch * sps, 1),
         "step_ms": round(step_s * 1e3, 3),
         "flops_per_step": flops,
         "mfu_pct": round(100 * m, 2) if m is not None else None,
     }
+    if hbm_bytes:
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.utils import hbm_bytes_per_sec
+        bw = hbm_bytes_per_sec()
+        out["hbm_gb_per_step"] = round(hbm_bytes / 1e9, 2)
+        if bw:
+            out["hbm_roofline_frac"] = round((hbm_bytes / bw) / step_s, 3)
+    return out
 
 
 def measure_flash_vs_dense() -> dict:
@@ -240,14 +255,20 @@ def measure_torch_cpu_baseline() -> float:
 
 LADDER = [
     # (key, model, input_shape, batch, steps, num_classes, token_task,
-    #  per-entry subprocess timeout in seconds)
+    #  per-entry subprocess timeout in seconds[, extra model kwargs])
     ("mlp_mnist", "mlp", (28, 28, 1), 256, 200, 10, False, 120),
     ("lenet5_mnist", "lenet5", (28, 28, 1), 256, 200, 10, False, 120),
     ("resnet18_cifar10", "resnet18", (32, 32, 3), 256, 100, 10, False, 180),
     ("resnet50_imagenet", "resnet50", (224, 224, 3), 128, 20, 1000, False, 300),
     ("bert_base_mlm_l128", "bert_base", (128,), 64, 20, 30522, True, 300),
+    ("vit_s16_imagenet", "vit_s16", (224, 224, 3), 128, 20, 1000, False, 300),
+    ("vit_b16_imagenet", "vit_b16", (224, 224, 3), 128, 10, 1000, False, 360),
     ("gpt2_small_lm_l512", "gpt2_small", (512,), 16, 20, 50257, True, 300),
     ("enhanced_cnn_cifar10", "enhanced_cnn", (32, 32, 3), 256, 100, 10, False, 180),
+    # long-context capability row: Pallas flash attention end-to-end in a
+    # training step (dense XLA attention at this L is O(L^2)-HBM-bound)
+    ("gpt2_small_lm_l4096_flash", "gpt2_small", (4096,), 2, 10, 50257, True,
+     420, {"attention_impl": "flash", "max_len": 4096}),
 ]
 
 
@@ -255,9 +276,10 @@ def _run_entry(key: str) -> dict:
     """Run one entry in THIS process and print its JSON (subprocess mode)."""
     if key == "flash_attention":
         return measure_flash_vs_dense()
-    for k, name, shape, batch, steps, ncls, tok, _ in LADDER:
+    for k, name, shape, batch, steps, ncls, tok, _tmo, *extra in LADDER:
         if k == key:
-            return measure_model(name, shape, batch, steps, ncls, tok)
+            return measure_model(name, shape, batch, steps, ncls, tok,
+                                 **(extra[0] if extra else {}))
     raise SystemExit(f"unknown entry {key}")
 
 
@@ -269,7 +291,8 @@ def main() -> None:
     import subprocess
     details = {}
     # flash entry compiles 12 jit variants (2 impls x {fwd, train} x 3 L's)
-    jobs = [(k, t) for (k, *_, t) in LADDER] + [("flash_attention", 480)]
+    jobs = [(k, t) for (k, _n, _s, _b, _st, _nc, _tk, t, *_x) in LADDER] \
+        + [("flash_attention", 480)]
     for key, tmo in jobs:
         t0 = time.perf_counter()
         try:

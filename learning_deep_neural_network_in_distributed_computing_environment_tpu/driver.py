@@ -46,7 +46,7 @@ from .data import (
 from . import checkpoint as ckpt_lib
 from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS,
                    build_mesh, initialize_distributed)
-from .models import get_model, is_attention_model
+from .models import get_model, is_attention_model, is_token_model
 from .train import LocalSGDEngine, TrainState, rank0_variables
 
 log = logging.getLogger(__name__)
@@ -133,7 +133,7 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         if not is_attention_model(cfg.model):
             raise ValueError(
                 f"a '{PIPE_AXIS}' mesh axis (pipeline parallelism) applies "
-                f"to attention models (bert_*/gpt_*); got --model {cfg.model}")
+                f"to attention models (bert_*/gpt_*/vit_*); got --model {cfg.model}")
         if int(mesh.shape.get(MODEL_AXIS, 1)) > 1 \
                 or cfg.sequence_parallel != "none":
             raise NotImplementedError(
@@ -151,7 +151,7 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         # expert weights shard over it (expert parallelism)
         if not is_attention_model(cfg.model):
             raise ValueError(
-                f"--num_experts applies to attention models (bert_*/gpt_*); "
+                f"--num_experts applies to attention models (bert_*/gpt_*/vit_*); "
                 f"got --model {cfg.model}")
         if (pp > 1 or int(mesh.shape.get(MODEL_AXIS, 1)) > 1
                 or cfg.sequence_parallel != "none"):
@@ -179,7 +179,7 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         if not is_attention_model(cfg.model):
             raise ValueError(
                 f"a '{MODEL_AXIS}' mesh axis (tensor parallelism) applies "
-                f"to attention models (bert_*/gpt_*); got --model {cfg.model}")
+                f"to attention models (bert_*/gpt_*/vit_*); got --model {cfg.model}")
         from functools import partial
         from .models.bert import tp_param_specs
         train_kw.update(tp_size=tp, model_axis=MODEL_AXIS)
@@ -220,9 +220,9 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                 f"--sequence_parallel {cfg.sequence_parallel} needs a "
                 f"'{SEQ_AXIS}' mesh axis of size >= 2 (e.g. --mesh_shape "
                 f"data=2,seq=4); got mesh {dict(mesh.shape)}")
-        if not is_attention_model(cfg.model):
+        if not is_token_model(cfg.model):
             raise ValueError(
-                "--sequence_parallel applies to attention models "
+                "--sequence_parallel applies to token-sequence models "
                 f"(bert_*/gpt_*); got --model {cfg.model}")
         # the round program runs ring / all-to-all attention over the seq
         # axis; init/probe/final-eval keep the dense twin (same params)
@@ -232,7 +232,7 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         if not is_attention_model(cfg.model):
             raise ValueError(
                 "--attention_impl applies to attention models "
-                f"(bert_*/gpt_*); got --model {cfg.model}")
+                f"(bert_*/gpt_*/vit_*); got --model {cfg.model}")
         train_kw.update(attention_impl=cfg.attention_impl)
     if train_kw:
         train_model = build_model_for(cfg, num_classes, **base_kw, **train_kw)

@@ -53,12 +53,38 @@ def get_model(name: str, **kw: Any):
         kw.setdefault("num_heads", 4)
         kw.setdefault("ffn_dim", 128)
         return GPTForCausalLM(**kw)
+    if name == "vit_s16":
+        from .vit import ViT
+        return ViT(**kw)
+    if name == "vit_b16":
+        from .vit import ViT
+        kw.setdefault("hidden", 768)
+        kw.setdefault("num_heads", 12)
+        kw.setdefault("ffn_dim", 3072)
+        return ViT(**kw)
+    if name == "vit_tiny":
+        # CPU-testable ViT for 32x32 inputs (same code path as vit_s16)
+        from .vit import ViT
+        kw.setdefault("patch", 8)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("hidden", 64)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("ffn_dim", 128)
+        return ViT(**kw)
     raise ValueError(f"unknown model {name!r}")
 
 
 def is_attention_model(name: str) -> bool:
-    """True for transformer families (bert_*/gpt_*) — the models that
-    accept attention/parallelism kwargs (TP, SP, PP, attention_impl)."""
+    """True for transformer families (bert_*/gpt_*/vit_*) — the models
+    that accept attention/parallelism kwargs (TP, PP, MoE,
+    attention_impl)."""
+    return name.lower().startswith(("bert", "gpt", "vit"))
+
+
+def is_token_model(name: str) -> bool:
+    """True for models whose input is a token-id sequence [B, L] — the
+    shape sequence parallelism shards.  ViT is attention-based but takes
+    images, so SP does not apply."""
     return name.lower().startswith(("bert", "gpt"))
 
 
@@ -73,4 +99,7 @@ MODEL_INPUT_SPECS = {
     "bert_tiny": ((128,), 30522),
     "gpt2_small": ((128,), 50257),
     "gpt_tiny": ((128,), 50257),
+    "vit_s16": ((224, 224, 3), 1000),
+    "vit_b16": ((224, 224, 3), 1000),
+    "vit_tiny": ((32, 32, 3), 10),
 }

@@ -127,6 +127,29 @@ class _ScanLayer(nn.Module):
         return y, None
 
 
+def apply_scanned_stack(scan_layer_cls, x, *, num_layers: int, pp_size: int,
+                        pipeline_axis, num_microbatches: int, train: bool,
+                        **layer_kw):
+    """``nn.scan`` the stacked ``layers`` collection and run it plain or as
+    a GPipe schedule — shared by BERT/GPT/ViT.  The stacked collection's
+    leading [num_layers] axis is what ``pp_param_specs`` shards over
+    ``pipe``; with a ``pipeline_axis`` this device applies its
+    ``num_layers // pp_size`` local layers per schedule step."""
+    if num_layers % pp_size:
+        raise ValueError(f"num_layers {num_layers} not divisible "
+                         f"by pp_size {pp_size}")
+    n_local = num_layers // pp_size
+    scanned = nn.scan(
+        scan_layer_cls, variable_axes={"params": 0},
+        split_rngs={"params": True}, length=n_local)(
+            train=train, name="layers", **layer_kw)
+    if pipeline_axis is None:
+        return scanned(x, None)[0]
+    from ..parallel.pp import gpipe_apply_scanned
+    return gpipe_apply_scanned(scanned, x, pipeline_axis, pp_size,
+                               num_microbatches)
+
+
 class BertForMLM(nn.Module):
     """Token ids [B, L] -> MLM logits [B, L, vocab].
 
@@ -222,22 +245,14 @@ class BertForMLM(nn.Module):
                         dtype=self.dtype, name="mlm_decoder")(x)
 
     def _encode_scanned(self, x, train: bool):
-        if self.num_layers % self.pp_size:
-            raise ValueError(f"num_layers {self.num_layers} not divisible "
-                             f"by pp_size {self.pp_size}")
-        n_local = self.num_layers // self.pp_size
-        scanned = nn.scan(
-            _ScanLayer, variable_axes={"params": 0},
-            split_rngs={"params": True}, length=n_local)(
-                self.num_heads, self.ffn_dim, dtype=self.dtype,
-                attention_impl=self.attention_impl, axis_name=self.axis_name,
-                tp_size=self.tp_size, model_axis=self.model_axis,
-                train=train, name="layers")
-        if self.pipeline_axis is None:
-            return scanned(x, None)[0]
-        from ..parallel.pp import gpipe_apply_scanned
-        return gpipe_apply_scanned(scanned, x, self.pipeline_axis,
-                                   self.pp_size, self.num_microbatches)
+        return apply_scanned_stack(
+            _ScanLayer, x, num_layers=self.num_layers, pp_size=self.pp_size,
+            pipeline_axis=self.pipeline_axis,
+            num_microbatches=self.num_microbatches, train=train,
+            num_heads=self.num_heads, ffn_dim=self.ffn_dim,
+            dtype=self.dtype, attention_impl=self.attention_impl,
+            axis_name=self.axis_name, tp_size=self.tp_size,
+            model_axis=self.model_axis)
 
 
 def tp_param_specs(params, axis: str = "model"):

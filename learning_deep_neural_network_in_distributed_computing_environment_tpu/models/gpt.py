@@ -173,19 +173,12 @@ class GPTForCausalLM(nn.Module):
         return tok_emb.attend(x)
 
     def _decode_scanned(self, x, train: bool):
-        if self.num_layers % self.pp_size:
-            raise ValueError(f"num_layers {self.num_layers} not divisible "
-                             f"by pp_size {self.pp_size}")
-        n_local = self.num_layers // self.pp_size
-        scanned = nn.scan(
-            _ScanBlock, variable_axes={"params": 0},
-            split_rngs={"params": True}, length=n_local)(
-                self.num_heads, self.ffn_dim, dtype=self.dtype,
-                attention_impl=self.attention_impl, axis_name=self.axis_name,
-                tp_size=self.tp_size, model_axis=self.model_axis,
-                train=train, name="layers")
-        if self.pipeline_axis is None:
-            return scanned(x, None)[0]
-        from ..parallel.pp import gpipe_apply_scanned
-        return gpipe_apply_scanned(scanned, x, self.pipeline_axis,
-                                   self.pp_size, self.num_microbatches)
+        from .bert import apply_scanned_stack
+        return apply_scanned_stack(
+            _ScanBlock, x, num_layers=self.num_layers, pp_size=self.pp_size,
+            pipeline_axis=self.pipeline_axis,
+            num_microbatches=self.num_microbatches, train=train,
+            num_heads=self.num_heads, ffn_dim=self.ffn_dim,
+            dtype=self.dtype, attention_impl=self.attention_impl,
+            axis_name=self.axis_name, tp_size=self.tp_size,
+            model_axis=self.model_axis)

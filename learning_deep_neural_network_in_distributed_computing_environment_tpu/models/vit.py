@@ -1,0 +1,100 @@
+"""Vision Transformer (beyond-reference model family).
+
+The reference's only model is a CNN (``Balanced All-Reduce/model.py:74-111``);
+this adds the transformer vision family on top of the SAME encoder stack as
+BERT/GPT (``models/bert.py:EncoderLayer``), so every encoder capability —
+flash attention, Megatron tensor parallelism, GPipe pipeline parallelism
+(``scan_layers``), Switch-MoE FFNs — composes with image classification for
+free.  Sequence parallelism is the one exclusion: the engine's seq-sharded
+input packs are token ids, not images.
+
+TPU-first patchify: a reshape + one Dense (``[B, N, p*p*c] @ [p*p*c, H]``)
+instead of the usual stride-p conv — identical math for non-overlapping
+patches, and it lowers to a single MXU matmul with no small-channel conv
+edge cases.
+
+Defaults are ViT-S/16 (12 layers, hidden 384, 6 heads, FFN 1536) — the
+matmul-dominated geometry that actually exercises the MXU at high
+utilization, unlike the HBM-roofline-bound ResNets.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .bert import EncoderLayer, _ScanLayer, _init
+
+
+class ViT(nn.Module):
+    """Images [B, H, W, C] -> class logits [B, num_classes]."""
+
+    num_classes: int = 1000
+    patch: int = 16
+    num_layers: int = 12
+    hidden: int = 384
+    num_heads: int = 6
+    ffn_dim: int = 1536
+    dtype: Any = jnp.float32
+    attention_impl: str = "dense"
+    tp_size: int = 1
+    model_axis: Optional[str] = None
+    scan_layers: bool = False
+    pipeline_axis: Optional[str] = None
+    pp_size: int = 1
+    num_microbatches: int = 0
+    num_experts: int = 0
+    expert_axis: Optional[str] = None
+    ep_size: int = 1
+    capacity_factor: float = 1.25
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        b, h, w, c = x.shape
+        p = self.patch
+        if h % p or w % p:
+            raise ValueError(f"input {h}x{w} not divisible by patch {p}")
+        x = jnp.asarray(x, self.dtype)
+        # non-overlapping patchify as reshape + matmul (see module docstring)
+        x = x.reshape(b, h // p, p, w // p, p, c)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(
+            b, (h // p) * (w // p), p * p * c)
+        x = nn.Dense(self.hidden, kernel_init=_init, dtype=self.dtype,
+                     name="patch_embed")(x)
+        pos = self.param("pos_emb", _init, (1, x.shape[1], self.hidden))
+        x = x + pos.astype(x.dtype)
+        if self.scan_layers:
+            x = self._encode_scanned(x, train)
+        else:
+            for i in range(self.num_layers):
+                x = EncoderLayer(self.num_heads, self.ffn_dim,
+                                 dtype=self.dtype,
+                                 attention_impl=self.attention_impl,
+                                 tp_size=self.tp_size,
+                                 model_axis=self.model_axis,
+                                 num_experts=self.num_experts,
+                                 expert_axis=self.expert_axis,
+                                 ep_size=self.ep_size,
+                                 capacity_factor=self.capacity_factor,
+                                 name=f"layer{i}")(x, train=train)
+        x = x.mean(axis=1)  # global average pool over patches
+        return nn.Dense(self.num_classes, kernel_init=_init,
+                        dtype=jnp.float32, name="head")(
+                            jnp.asarray(x, jnp.float32))
+
+    def _encode_scanned(self, x, train: bool):
+        if self.num_experts:
+            raise NotImplementedError(
+                "MoE layers do not yet compose with scan_layers/pipeline "
+                "parallelism (the sown aux loss would need lifting through "
+                "nn.scan)")
+        from .bert import apply_scanned_stack
+        return apply_scanned_stack(
+            _ScanLayer, x, num_layers=self.num_layers, pp_size=self.pp_size,
+            pipeline_axis=self.pipeline_axis,
+            num_microbatches=self.num_microbatches, train=train,
+            num_heads=self.num_heads, ffn_dim=self.ffn_dim,
+            dtype=self.dtype, attention_impl=self.attention_impl,
+            tp_size=self.tp_size, model_axis=self.model_axis)

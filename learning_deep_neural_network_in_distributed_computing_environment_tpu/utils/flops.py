@@ -28,6 +28,31 @@ _PEAK_BF16 = (
 )
 
 
+# HBM bandwidth per chip, bytes/s (public spec sheets), same matching rule.
+_HBM_BW = (
+    ("v6", 1638e9),        # Trillium / v6e
+    ("v5p", 2765e9),
+    ("v5 lite", 819e9),
+    ("v5e", 819e9),
+    ("v5", 2765e9),
+    ("v4", 1228e9),
+    ("v3", 900e9),
+    ("v2", 700e9),
+)
+
+
+def hbm_bytes_per_sec(device: Optional[jax.Device] = None) -> Optional[float]:
+    """HBM bandwidth of one chip in bytes/s, or None when unknown."""
+    d = device or jax.devices()[0]
+    if d.platform != "tpu":
+        return None
+    kind = d.device_kind.lower()
+    for key, bw in _HBM_BW:
+        if key in kind:
+            return bw
+    return None
+
+
 def peak_flops(device: Optional[jax.Device] = None) -> Optional[float]:
     """Peak bf16 FLOP/s of one chip, or None when unknown (e.g. CPU)."""
     d = device or jax.devices()[0]

@@ -1,0 +1,104 @@
+"""Vision Transformer (``models/vit.py``) — beyond-reference model family
+on the shared encoder stack.
+
+The reshape+matmul patchify is golden-tested against the equivalent
+stride-p convolution; the driver paths cover plain DP, tensor parallelism
+(reusing bert.tp_param_specs via the shared EncoderLayer), and GPipe
+pipeline parallelism over scanned layers.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.mesh import build_mesh
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import get_model
+
+
+class TestViTModule:
+    def test_forward_shape_and_finite(self):
+        model = get_model("vit_tiny", num_classes=10)
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 32, 32, 3)),
+                        jnp.float32)
+        variables = model.init(jax.random.key(0), x, train=False)
+        out = model.apply(variables, x, train=False)
+        assert out.shape == (2, 10)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_patchify_equals_stride_conv(self):
+        """reshape+Dense patch embedding == Conv(kernel=p, stride=p) with
+        the same weights (the TPU-first formulation is exact, not an
+        approximation)."""
+        model = get_model("vit_tiny", num_classes=10)
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(2, 32, 32, 3)), jnp.float32)
+        variables = model.init(jax.random.key(1), x, train=False)
+        kernel = variables["params"]["patch_embed"]["kernel"]  # [p*p*c, H]
+        bias = variables["params"]["patch_embed"]["bias"]
+        p, c, hdim = 8, 3, kernel.shape[1]
+
+        # the module's own patch tokens
+        xt = x.reshape(2, 4, p, 4, p, c).transpose(0, 1, 3, 2, 4, 5)
+        tokens = xt.reshape(2, 16, p * p * c) @ kernel + bias
+
+        conv_kernel = kernel.reshape(p, p, c, hdim)
+        conv_out = lax.conv_general_dilated(
+            x, conv_kernel, (p, p), "VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC")) + bias
+        np.testing.assert_allclose(
+            tokens, conv_out.reshape(2, 16, hdim), rtol=2e-5, atol=1e-5)
+
+    def test_param_count_vit_s16(self):
+        """ViT-S/16 at 224^2/1000 classes: ~22M params (sanity that the
+        geometry matches the standard family)."""
+        model = get_model("vit_s16", num_classes=1000)
+        x = jnp.zeros((1, 224, 224, 3), jnp.float32)
+        variables = jax.eval_shape(
+            lambda k: model.init(k, x, train=False), jax.random.key(0))
+        n = sum(int(np.prod(l.shape)) for l in
+                jax.tree_util.tree_leaves(variables["params"]))
+        assert 21_000_000 < n < 23_500_000, n
+
+
+def _run(devices, mesh_axes, **cfg_kw):
+    mesh = build_mesh(mesh_axes, devices)
+    cfg = Config(model="vit_tiny", dataset="cifar10", epochs_global=2,
+                 epochs_local=1, batch_size=8, limit_train_samples=128,
+                 limit_eval_samples=32, compute_dtype="float32",
+                 augment=False, aggregation_by="weights", seed=13, **cfg_kw)
+    return train_global(cfg, mesh=mesh, progress=False)
+
+
+class TestDriverViT:
+    def test_plain_dp_loss_decreases(self, devices):
+        res = _run(devices[:2], {"data": 2})
+        assert np.isfinite(res["global_train_losses"]).all()
+        assert res["global_train_losses"][-1] < res["global_train_losses"][0]
+
+    def test_tensor_parallel_matches_dense(self, devices):
+        dense = _run(devices[:2], {"data": 2})
+        tp = _run(devices[:4], {"data": 2, "model": 2})
+        np.testing.assert_allclose(tp["global_train_losses"],
+                                   dense["global_train_losses"], rtol=2e-3)
+
+    def test_pipeline_parallel_runs(self, devices):
+        res = _run(devices[:4], {"data": 2, "pipe": 2})
+        assert np.isfinite(res["global_train_losses"]).all()
+
+    def test_fsdp_matches_dense(self, devices):
+        dense = _run(devices[:2], {"data": 2})
+        fsdp = _run(devices[:4], {"data": 2, "fsdp": 2})
+        np.testing.assert_allclose(fsdp["global_train_losses"],
+                                   dense["global_train_losses"], rtol=2e-3)
+
+    def test_sequence_parallel_rejected(self, devices):
+        mesh = build_mesh({"data": 2, "seq": 2}, devices[:4])
+        cfg = Config(model="vit_tiny", dataset="cifar10", batch_size=8,
+                     limit_train_samples=64, limit_eval_samples=16,
+                     augment=False, sequence_parallel="ring")
+        with pytest.raises(ValueError, match="token-sequence"):
+            train_global(cfg, mesh=mesh, progress=False)
