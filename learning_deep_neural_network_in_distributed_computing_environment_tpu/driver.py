@@ -190,8 +190,10 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         # ZeRO-3 / FSDP (parallel/fsdp.py): params + Adam moments sharded
         # over 'fsdp', each worker's batch split over it, params
         # all-gathered per step (gradients reduce-scattered by autodiff).
-        # Works for every model family — the model code never sees shards.
-        if (pp > 1 or tp > 1 or ep > 1 or cfg.num_experts > 0
+        # Works for every model family — the model code never sees shards —
+        # and composes with tensor parallelism (2-D (fsdp, model) sharding:
+        # ZeRO-3 claims a free dim of each TP-sharded leaf).
+        if (pp > 1 or ep > 1 or cfg.num_experts > 0
                 or cfg.sequence_parallel != "none"):
             # MoE even without an expert axis: per-sub-batch routing would
             # change capacity semantics and the psum over fsdp would scale
@@ -199,15 +201,24 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             # above)
             raise NotImplementedError(
                 f"a '{FSDP_AXIS}' mesh axis does not yet compose with "
-                "tensor/pipeline/sequence/expert parallelism or MoE")
+                "pipeline/sequence/expert parallelism or MoE")
         if cfg.batch_size % fsdp:
             raise ValueError(
                 f"--batch_size {cfg.batch_size} must be divisible by the "
                 f"'{FSDP_AXIS}' axis size {fsdp} (the batch splits over it)")
-        from functools import partial
-        from .parallel.fsdp import fsdp_param_specs
-        param_specs_fn = partial(fsdp_param_specs, axis=FSDP_AXIS,
-                                 axis_size=fsdp)
+        from .parallel.fsdp import add_fsdp_axis, fsdp_param_specs
+        if tp > 1:
+            # 2-D composition: wrap the spec fn the TP block above chose
+            # with fsdp sharding on a free dim of each large leaf
+            base_specs_fn = param_specs_fn
+
+            def param_specs_fn(params):
+                return add_fsdp_axis(base_specs_fn(params), params,
+                                     axis=FSDP_AXIS, axis_size=fsdp)
+        else:
+            from functools import partial
+            param_specs_fn = partial(fsdp_param_specs, axis=FSDP_AXIS,
+                                     axis_size=fsdp)
     if cfg.sequence_parallel != "none":
         if cfg.attention_impl != "dense":
             raise ValueError(

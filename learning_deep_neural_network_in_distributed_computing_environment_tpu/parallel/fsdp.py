@@ -44,13 +44,14 @@ from jax.sharding import PartitionSpec as P
 MIN_SHARD_ELEMS = 1 << 14
 
 
-def _shard_dim(shape: tuple[int, ...], size: int, k: int) -> int | None:
-    """First dimension divisible by ``k`` for a leaf of ``size`` elements;
-    None -> replicate."""
+def _shard_dim(shape: tuple[int, ...], size: int, k: int,
+               occupied: frozenset[int] = frozenset()) -> int | None:
+    """First non-``occupied`` dimension divisible by ``k`` for a leaf of
+    ``size`` elements; None -> replicate."""
     if size < MIN_SHARD_ELEMS:
         return None
     for d, s in enumerate(shape):
-        if s % k == 0 and s >= k:
+        if d not in occupied and s % k == 0 and s >= k:
             return d
     return None
 
@@ -79,6 +80,26 @@ def _map_with_specs(fn, tree, specs):
         specs, is_leaf=lambda x: isinstance(x, P))[0]
     return treedef.unflatten(
         [fn(l, s) for l, s in zip(leaves, spec_leaves)])
+
+
+def add_fsdp_axis(specs, params, *, axis: str, axis_size: int):
+    """Extend an existing PartitionSpec tree (e.g. Megatron TP specs) with
+    ``axis`` on a FREE dimension of each large leaf — the 2-D composition
+    (worker, fsdp, model) used when ZeRO-3 runs inside tensor parallelism.
+    Dims already claimed by another axis are skipped; leaves with no free
+    divisible dim stay fsdp-replicated (their grads get the psum in
+    ``reduce_replicated_grads``)."""
+
+    def ext(leaf, spec):
+        parts = list(spec) + [None] * (leaf.ndim - len(spec))
+        occupied = frozenset(d for d, p in enumerate(parts) if p)
+        d = _shard_dim(leaf.shape, leaf.size, axis_size, occupied)
+        if d is None:
+            return spec
+        parts[d] = axis
+        return P(*parts)
+
+    return _map_with_specs(ext, params, specs)
 
 
 def gather_params(shards, specs, axis: str):

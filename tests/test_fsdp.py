@@ -167,8 +167,22 @@ class TestDriverFSDP:
         with pytest.raises(ValueError, match="divisible"):
             train_global(cfg, mesh=mesh, progress=False)
 
-    def test_no_composition_with_tp(self, devices):
-        mesh = build_mesh({"data": 1, "fsdp": 2, "model": 2}, devices[:4])
+    def test_composes_with_tp(self, devices):
+        """2-D (fsdp, model) sharding inside each worker: ZeRO-3 claims a
+        free dim of every large TP-sharded leaf; numerics must match the
+        plain data=2 run."""
+        plain = _run(devices[:2], {"data": 2}, model="bert_tiny",
+                     dataset="synthetic_mlm")
+        both = _run(devices[:8], {"data": 2, "fsdp": 2, "model": 2},
+                    model="bert_tiny", dataset="synthetic_mlm")
+        np.testing.assert_allclose(both["global_train_losses"],
+                                   plain["global_train_losses"], rtol=2e-3)
+        specs = [str(l.sharding.spec) for l in
+                 jax.tree_util.tree_leaves(both["state"].params)]
+        assert any("fsdp" in s and "model" in s for s in specs)
+
+    def test_no_composition_with_pp(self, devices):
+        mesh = build_mesh({"data": 1, "fsdp": 2, "pipe": 2}, devices[:4])
         cfg = Config(model="bert_tiny", dataset="synthetic_mlm",
                      batch_size=8, limit_train_samples=64,
                      limit_eval_samples=16, augment=False)
