@@ -134,11 +134,10 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             raise ValueError(
                 f"a '{PIPE_AXIS}' mesh axis (pipeline parallelism) applies "
                 f"to attention models (bert_*/gpt_*/vit_*); got --model {cfg.model}")
-        if int(mesh.shape.get(MODEL_AXIS, 1)) > 1 \
-                or cfg.sequence_parallel != "none":
+        if cfg.sequence_parallel != "none":
             raise NotImplementedError(
-                "pipeline parallelism does not yet compose with a 'model' "
-                "axis or --sequence_parallel")
+                "pipeline parallelism does not yet compose with "
+                "--sequence_parallel")
         from functools import partial
         from .parallel.pp import pp_param_specs
         base_kw.update(scan_layers=True)
@@ -181,9 +180,16 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                 f"a '{MODEL_AXIS}' mesh axis (tensor parallelism) applies "
                 f"to attention models (bert_*/gpt_*/vit_*); got --model {cfg.model}")
         from functools import partial
-        from .models.bert import tp_param_specs
+        from .models.bert import pp_tp_param_specs, tp_param_specs
         train_kw.update(tp_size=tp, model_axis=MODEL_AXIS)
-        param_specs_fn = partial(tp_param_specs, axis=MODEL_AXIS)
+        if pp > 1:
+            # 2-D composition: the stacked layer axis shards over 'pipe'
+            # AND the inner Megatron dims over 'model' (the dense twin
+            # keeps the same stacked structure via scan_layers)
+            param_specs_fn = partial(pp_tp_param_specs,
+                                     pipe_axis=PIPE_AXIS, axis=MODEL_AXIS)
+        else:
+            param_specs_fn = partial(tp_param_specs, axis=MODEL_AXIS)
     from .mesh import FSDP_AXIS
     fsdp = int(mesh.shape.get(FSDP_AXIS, 1))
     if fsdp > 1:

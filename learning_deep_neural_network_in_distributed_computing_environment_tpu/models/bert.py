@@ -255,9 +255,10 @@ class BertForMLM(nn.Module):
             model_axis=self.model_axis)
 
 
-def tp_param_specs(params, axis: str = "model"):
-    """PartitionSpec tree sharding BERT parameters over the TP ``axis``
-    (no worker axis — the engine prepends it).
+def _tp_parts(names: list, ndim: int, axis: str):
+    """Megatron sharding pattern for one leaf, as a parts list of length
+    ``ndim`` (the UNSTACKED leaf rank — callers with a leading layer dim
+    pass ``leaf.ndim - 1``).
 
     qkv kernel [H, 3, heads, hd] / bias [3, heads, hd]: heads dim sharded;
     attn out kernel [heads, hd, H] and ffn_out kernel [F, H]: dim 0 sharded
@@ -266,20 +267,43 @@ def tp_param_specs(params, axis: str = "model"):
     — column-parallel over the vocabulary); everything else (embeddings,
     LNs, post-reduce biases, the MLM transform) replicated.
     """
+    parts = [None] * ndim
+    if "qkv" in names:
+        parts[2 if ndim == 4 else 1] = axis
+    elif "out" in names and ndim == 3:   # kernel [heads, hd, H]
+        parts[0] = axis
+    elif "ffn_in" in names:
+        parts[1 if ndim == 2 else 0] = axis
+    elif "ffn_out" in names and ndim == 2:   # kernel [F, H]
+        parts[0] = axis
+    elif "mlm_decoder" in names:         # kernel [H, V] / bias [V]
+        parts[1 if ndim == 2 else 0] = axis
+    return parts
+
+
+def tp_param_specs(params, axis: str = "model"):
+    """PartitionSpec tree sharding BERT parameters over the TP ``axis``
+    (no worker axis — the engine prepends it); pattern in ``_tp_parts``."""
     from jax.sharding import PartitionSpec as P
 
     def spec(path, leaf):
         names = [getattr(p, "key", str(p)) for p in path]
-        if "qkv" in names:
-            return P(None, None, axis, None) if leaf.ndim == 4 \
-                else P(None, axis, None)
-        if "out" in names:               # kernel [heads, hd, H]
-            return P(axis, None, None)
-        if "ffn_in" in names:
-            return P(None, axis) if leaf.ndim == 2 else P(axis)
-        if "ffn_out" in names:           # kernel [F, H]
-            return P(axis, None)
-        if "mlm_decoder" in names:       # kernel [H, V] / bias [V]
-            return P(None, axis) if leaf.ndim == 2 else P(axis)
-        return P()
+        return P(*_tp_parts(names, leaf.ndim, axis))
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def pp_tp_param_specs(params, *, pipe_axis: str = "pipe",
+                      axis: str = "model"):
+    """PartitionSpec tree for a ``scan_layers`` model under BOTH pipeline
+    and tensor parallelism: leaves under the stacked ``layers`` collection
+    shard their leading (layer) dim over ``pipe_axis`` AND their inner dims
+    per the Megatron pattern; everything outside the stack (embeddings,
+    the vocab-parallel MLM decode) gets the plain TP pattern."""
+    from jax.sharding import PartitionSpec as P
+
+    def spec(path, leaf):
+        names = [getattr(p, "key", str(p)) for p in path]
+        if "layers" in names:
+            return P(pipe_axis, *_tp_parts(names, leaf.ndim - 1, axis))
+        return P(*_tp_parts(names, leaf.ndim, axis))
     return jax.tree_util.tree_map_with_path(spec, params)

@@ -127,3 +127,43 @@ class TestDriverPipelineParallel:
                      limit_eval_samples=16, augment=False)
         with pytest.raises(ValueError, match="pipe"):
             train_global(cfg, mesh=mesh, progress=False)
+
+
+class TestDriverPipelineTensorParallel:
+    """3-D composition: (data=2, pipe=2, model=2) — the stacked layer axis
+    shards over 'pipe' AND the inner Megatron dims over 'model'
+    (bert.pp_tp_param_specs); numerics must match the dense data=2 run."""
+
+    def test_matches_dense_run(self, devices):
+        run = TestDriverPipelineParallel()
+        dense = run._run(devices[:2], {"data": 2})
+        both = run._run(devices[:8], {"data": 2, "pipe": 2, "model": 2})
+        np.testing.assert_allclose(both["global_train_losses"],
+                                   dense["global_train_losses"], rtol=2e-3)
+        specs = [str(l.sharding.spec) for l in
+                 jax.tree_util.tree_leaves(both["state"].params)]
+        assert any("pipe" in s and "model" in s for s in specs)
+
+    def test_pp_tp_specs_pattern(self):
+        """Stacked leaves get ('pipe', <megatron parts>); the vocab-parallel
+        decode outside the stack keeps its plain TP spec."""
+        from jax.sharding import PartitionSpec as P
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import get_model
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.models.bert import (
+            pp_tp_param_specs,
+        )
+        model = get_model("bert_tiny", num_classes=96, scan_layers=True)
+        x = jnp.zeros((2, 16), jnp.int32)
+        variables = jax.eval_shape(
+            lambda k: model.init(k, x, train=False), jax.random.key(0))
+        specs = pp_tp_param_specs(variables["params"], pipe_axis="pipe",
+                                  axis="model")
+        flat = {jax.tree_util.keystr(p): s for p, s in
+                jax.tree_util.tree_leaves_with_path(
+                    specs, is_leaf=lambda s: isinstance(s, P))}
+        qkv = next(s for k, s in flat.items()
+                   if "layers" in k and "qkv" in k and "kernel" in k)
+        assert qkv[0] == "pipe" and "model" in qkv
+        dec = next(s for k, s in flat.items()
+                   if "mlm_decoder" in k and "kernel" in k)
+        assert dec == P(None, "model")
