@@ -269,6 +269,9 @@ LADDER = [
     # training step (dense XLA attention at this L is O(L^2)-HBM-bound)
     ("gpt2_small_lm_l4096_flash", "gpt2_small", (4096,), 2, 10, 50257, True,
      420, {"attention_impl": "flash", "max_len": 4096}),
+    # modern decoder recipe: RMSNorm + RoPE + SwiGLU, untied head
+    ("llama_medium_lm_l1024", "llama_medium", (1024,), 8, 10, 32000, True,
+     420, {"attention_impl": "flash"}),
 ]
 
 

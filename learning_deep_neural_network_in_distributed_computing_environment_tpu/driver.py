@@ -133,7 +133,7 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         if not is_attention_model(cfg.model):
             raise ValueError(
                 f"a '{PIPE_AXIS}' mesh axis (pipeline parallelism) applies "
-                f"to attention models (bert_*/gpt_*/vit_*); got --model {cfg.model}")
+                f"to attention models (bert_*/gpt_*/vit_*/llama_*); got --model {cfg.model}")
         if cfg.sequence_parallel != "none":
             raise NotImplementedError(
                 "pipeline parallelism does not yet compose with "
@@ -150,7 +150,7 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         # expert weights shard over it (expert parallelism)
         if not is_attention_model(cfg.model):
             raise ValueError(
-                f"--num_experts applies to attention models (bert_*/gpt_*/vit_*); "
+                f"--num_experts applies to attention models (bert_*/gpt_*/vit_*/llama_*); "
                 f"got --model {cfg.model}")
         if (pp > 1 or int(mesh.shape.get(MODEL_AXIS, 1)) > 1
                 or cfg.sequence_parallel != "none"):
@@ -178,7 +178,7 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         if not is_attention_model(cfg.model):
             raise ValueError(
                 f"a '{MODEL_AXIS}' mesh axis (tensor parallelism) applies "
-                f"to attention models (bert_*/gpt_*/vit_*); got --model {cfg.model}")
+                f"to attention models (bert_*/gpt_*/vit_*/llama_*); got --model {cfg.model}")
         from functools import partial
         from .models.bert import pp_tp_param_specs, tp_param_specs
         train_kw.update(tp_size=tp, model_axis=MODEL_AXIS)
@@ -240,7 +240,7 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         if not is_token_model(cfg.model):
             raise ValueError(
                 "--sequence_parallel applies to token-sequence models "
-                f"(bert_*/gpt_*); got --model {cfg.model}")
+                f"(bert_*/gpt_*/llama_*); got --model {cfg.model}")
         # the round program runs ring / all-to-all attention over the seq
         # axis; init/probe/final-eval keep the dense twin (same params)
         train_kw.update(attention_impl=cfg.sequence_parallel,
@@ -249,7 +249,7 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         if not is_attention_model(cfg.model):
             raise ValueError(
                 "--attention_impl applies to attention models "
-                f"(bert_*/gpt_*/vit_*); got --model {cfg.model}")
+                f"(bert_*/gpt_*/vit_*/llama_*); got --model {cfg.model}")
         train_kw.update(attention_impl=cfg.attention_impl)
     if train_kw:
         train_model = build_model_for(cfg, num_classes, **base_kw, **train_kw)

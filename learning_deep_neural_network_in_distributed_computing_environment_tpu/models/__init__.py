@@ -53,6 +53,17 @@ def get_model(name: str, **kw: Any):
         kw.setdefault("num_heads", 4)
         kw.setdefault("ffn_dim", 128)
         return GPTForCausalLM(**kw)
+    if name == "llama_medium":
+        from .llama import LlamaForCausalLM
+        return LlamaForCausalLM(**kw)
+    if name == "llama_tiny":
+        # CPU-testable Llama (same code path as llama_medium, 2 layers)
+        from .llama import LlamaForCausalLM
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("hidden", 64)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("ffn_dim", 176)
+        return LlamaForCausalLM(**kw)
     if name == "vit_s16":
         from .vit import ViT
         return ViT(**kw)
@@ -75,17 +86,17 @@ def get_model(name: str, **kw: Any):
 
 
 def is_attention_model(name: str) -> bool:
-    """True for transformer families (bert_*/gpt_*/vit_*) — the models
-    that accept attention/parallelism kwargs (TP, PP, MoE,
+    """True for transformer families (bert_*/gpt_*/vit_*/llama_*) — the
+    models that accept attention/parallelism kwargs (TP, PP, MoE,
     attention_impl)."""
-    return name.lower().startswith(("bert", "gpt", "vit"))
+    return name.lower().startswith(("bert", "gpt", "vit", "llama"))
 
 
 def is_token_model(name: str) -> bool:
     """True for models whose input is a token-id sequence [B, L] — the
     shape sequence parallelism shards.  ViT is attention-based but takes
     images, so SP does not apply."""
-    return name.lower().startswith(("bert", "gpt"))
+    return name.lower().startswith(("bert", "gpt", "llama"))
 
 
 MODEL_INPUT_SPECS = {
@@ -99,6 +110,8 @@ MODEL_INPUT_SPECS = {
     "bert_tiny": ((128,), 30522),
     "gpt2_small": ((128,), 50257),
     "gpt_tiny": ((128,), 50257),
+    "llama_medium": ((1024,), 32000),
+    "llama_tiny": ((128,), 32000),
     "vit_s16": ((224, 224, 3), 1000),
     "vit_b16": ((224, 224, 3), 1000),
     "vit_tiny": ((32, 32, 3), 10),

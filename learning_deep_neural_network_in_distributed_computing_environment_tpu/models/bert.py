@@ -40,6 +40,8 @@ class SelfAttention(nn.Module):
     tp_size: int = 1
     model_axis: Optional[str] = None  # mesh axis for tensor parallelism
     causal: bool = False           # autoregressive masking (decoder models)
+    rope_theta: Optional[float] = None  # apply RoPE to q/k (Llama recipe)
+    use_bias: bool = True          # False => no qkv / output biases (Llama)
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -49,14 +51,28 @@ class SelfAttention(nn.Module):
         h_local = self.num_heads // self.tp_size
         x_in = copy_to_tp_region(x, self.model_axis)
         qkv = nn.DenseGeneral((3, h_local, head_dim), kernel_init=_init,
-                              dtype=self.dtype, name="qkv")(x_in)
+                              use_bias=self.use_bias, dtype=self.dtype,
+                              name="qkv")(x_in)
         q, k, v = (qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :])
+        if self.rope_theta is not None:
+            from jax import lax
+            from ..ops.attention import rope
+            pos = jnp.arange(x.shape[1])
+            if self.axis_name is not None:
+                # sequence-parallel: this device holds chunk axis_index, so
+                # absolute positions are offset by index * chunk length —
+                # rotated keys travel the ring already position-encoded
+                pos = pos + lax.axis_index(self.axis_name) * x.shape[1]
+            q = rope(q, pos, self.rope_theta)
+            k = rope(k, pos, self.rope_theta)
         out = attend(q, k, v, mask=mask, impl=self.attention_impl,
                      axis_name=self.axis_name, causal=self.causal)
         y = nn.DenseGeneral(d, axis=(-2, -1), kernel_init=_init,
                             use_bias=False, dtype=self.dtype,
                             name="out")(out)
         y = reduce_from_tp_region(y, self.model_axis)
+        if not self.use_bias:
+            return y
         return y + self.param("out_bias", nn.initializers.zeros,
                               (d,)).astype(y.dtype)
 
@@ -272,11 +288,14 @@ def _tp_parts(names: list, ndim: int, axis: str):
         parts[2 if ndim == 4 else 1] = axis
     elif "out" in names and ndim == 3:   # kernel [heads, hd, H]
         parts[0] = axis
-    elif "ffn_in" in names:
+    elif "ffn_in" in names or "ffn_up" in names:
+        # column-parallel: ffn_in kernel [H, F] / bias [F]; ffn_up is the
+        # SwiGLU second input projection (models/llama.py), same pattern
         parts[1 if ndim == 2 else 0] = axis
     elif "ffn_out" in names and ndim == 2:   # kernel [F, H]
         parts[0] = axis
-    elif "mlm_decoder" in names:         # kernel [H, V] / bias [V]
+    elif "mlm_decoder" in names or "lm_head" in names:
+        # vocab-parallel decode: kernel [H, V] / bias [V]
         parts[1 if ndim == 2 else 0] = axis
     return parts
 

@@ -1,0 +1,186 @@
+"""Llama-style causal language model (beyond-reference model family).
+
+The modern decoder recipe, from scratch in flax (no ``transformers``
+dependency): pre-norm **RMSNorm**, **rotary position embeddings** (RoPE,
+rotate-half convention — no learned position table, so sequence length is
+unbounded by parameters), **SwiGLU** FFN, no biases anywhere, and an
+UNTIED vocab-parallel-capable LM head.  The reference has no sequence
+models at all (its model is a CNN, SURVEY.md 2.3).
+
+All parallelism plumbing is shared with the BERT/GPT stack:
+
+- attention IS ``bert.SelfAttention(causal=True, rope_theta=..., use_bias=
+  False)`` — one shared module for dense, Pallas flash, and causal ring /
+  Ulysses sequence-parallel attention; it applies RoPE (``ops.attention.
+  rope``) to q/k before ``attend`` with absolute positions (offset by
+  ``lax.axis_index`` under sequence parallelism), so rotated keys travel
+  the ring already position-encoded;
+- tensor parallelism uses the Megatron construction with the shared
+  param-name patterns (``qkv``/``out`` sharded by head, ``ffn_in``/
+  ``ffn_up`` column-parallel, ``ffn_out`` row-parallel, ``lm_head``
+  vocab-parallel — ``bert._tp_parts``), so ``bert.tp_param_specs`` and
+  ``bert.pp_tp_param_specs`` apply unchanged;
+- ``scan_layers=True`` stacks the blocks for the GPipe schedule
+  (``bert.apply_scanned_stack``);
+- ``num_experts > 0`` swaps SwiGLU for the Switch-MoE FFN.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.attention import rope  # noqa: F401  (re-export; tests use it)
+from ..parallel.tp import copy_to_tp_region, reduce_from_tp_region
+from .bert import SelfAttention
+
+_init = nn.initializers.normal(stddev=0.02)
+
+
+class LlamaBlock(nn.Module):
+    """Pre-norm decoder block: x + attn(rms1(x)); x + swiglu(rms2(x))."""
+
+    num_heads: int
+    ffn_dim: int                   # GLOBAL SwiGLU hidden width
+    dtype: Any = jnp.float32
+    attention_impl: str = "dense"
+    axis_name: Optional[str] = None
+    tp_size: int = 1
+    model_axis: Optional[str] = None
+    rope_theta: float = 10000.0
+    num_experts: int = 0
+    expert_axis: Optional[str] = None
+    ep_size: int = 1
+    capacity_factor: float = 1.25
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        norm = lambda name: nn.RMSNorm(epsilon=1e-5, dtype=self.dtype,
+                                       name=name)
+        a = SelfAttention(self.num_heads, dtype=self.dtype,
+                          attention_impl=self.attention_impl,
+                          axis_name=self.axis_name, tp_size=self.tp_size,
+                          model_axis=self.model_axis, causal=True,
+                          rope_theta=self.rope_theta, use_bias=False,
+                          name="attn")(norm("rms1")(x))
+        x = x + a
+        f = norm("rms2")(x)
+        if self.num_experts:
+            from .moe import MoEFFN
+            f = MoEFFN(self.num_experts, self.ffn_dim,
+                       capacity_factor=self.capacity_factor,
+                       dtype=self.dtype, expert_axis=self.expert_axis,
+                       ep_size=self.ep_size, name="moe")(f, train=train)
+        else:
+            f_in = copy_to_tp_region(f, self.model_axis)
+            gate = nn.Dense(self.ffn_dim // self.tp_size, use_bias=False,
+                            kernel_init=_init, dtype=self.dtype,
+                            name="ffn_in")(f_in)
+            up = nn.Dense(self.ffn_dim // self.tp_size, use_bias=False,
+                          kernel_init=_init, dtype=self.dtype,
+                          name="ffn_up")(f_in)
+            f = nn.Dense(x.shape[-1], use_bias=False, kernel_init=_init,
+                         dtype=self.dtype,
+                         name="ffn_out")(nn.silu(gate) * up)
+            f = reduce_from_tp_region(f, self.model_axis)
+        return x + f
+
+
+class _ScanLlamaBlock(nn.Module):
+    """carry-API adapter so ``nn.scan`` can stack LlamaBlocks."""
+
+    num_heads: int
+    ffn_dim: int
+    dtype: Any = jnp.float32
+    attention_impl: str = "dense"
+    axis_name: Optional[str] = None
+    tp_size: int = 1
+    model_axis: Optional[str] = None
+    rope_theta: float = 10000.0
+    train: bool = False
+
+    @nn.compact
+    def __call__(self, x, _):
+        y = LlamaBlock(self.num_heads, self.ffn_dim, dtype=self.dtype,
+                       attention_impl=self.attention_impl,
+                       axis_name=self.axis_name, tp_size=self.tp_size,
+                       model_axis=self.model_axis,
+                       rope_theta=self.rope_theta, name="layer")(
+                           x, train=self.train)
+        return y, None
+
+
+class LlamaForCausalLM(nn.Module):
+    """Token ids [B, L] -> next-token logits [B, L, vocab] (or the LOCAL
+    vocab slice under tensor parallelism — vocab-parallel LM head)."""
+
+    num_classes: int = 32000       # vocab size (engine passes num_classes)
+    num_layers: int = 16
+    hidden: int = 1024
+    num_heads: int = 16
+    ffn_dim: int = 2816            # SwiGLU hidden (~2.75x hidden)
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.float32
+    attention_impl: str = "dense"
+    axis_name: Optional[str] = None
+    tp_size: int = 1
+    model_axis: Optional[str] = None
+    scan_layers: bool = False
+    pipeline_axis: Optional[str] = None
+    pp_size: int = 1
+    num_microbatches: int = 0      # 0 => pp_size
+    num_experts: int = 0           # >0 => Switch-MoE FFN in every block
+    expert_axis: Optional[str] = None
+    ep_size: int = 1
+    capacity_factor: float = 1.25
+
+    # class marker: with tp_size > 1 the untied lm_head outputs its LOCAL
+    # vocab slice and the engine's loss goes vocab-parallel
+    vocab_parallel_head = True
+
+    @nn.compact
+    def __call__(self, input_ids, *, train: bool = False):
+        if self.tp_size > 1 and self.num_classes % self.tp_size:
+            raise ValueError(
+                f"vocab size {self.num_classes} not divisible by tp_size "
+                f"{self.tp_size} (vocab-parallel LM head)")
+        x = nn.Embed(self.num_classes, self.hidden, embedding_init=_init,
+                     dtype=self.dtype, name="tok_emb")(input_ids)
+        # no position table: RoPE inside attention carries all position info
+        if self.scan_layers:
+            if self.num_experts:
+                raise NotImplementedError(
+                    "MoE blocks do not yet compose with scan_layers/"
+                    "pipeline parallelism (the sown aux loss would need "
+                    "lifting through nn.scan)")
+            from .bert import apply_scanned_stack
+            x = apply_scanned_stack(
+                _ScanLlamaBlock, x, num_layers=self.num_layers,
+                pp_size=self.pp_size, pipeline_axis=self.pipeline_axis,
+                num_microbatches=self.num_microbatches, train=train,
+                num_heads=self.num_heads, ffn_dim=self.ffn_dim,
+                dtype=self.dtype, attention_impl=self.attention_impl,
+                axis_name=self.axis_name, tp_size=self.tp_size,
+                model_axis=self.model_axis, rope_theta=self.rope_theta)
+        else:
+            for i in range(self.num_layers):
+                x = LlamaBlock(self.num_heads, self.ffn_dim,
+                               dtype=self.dtype,
+                               attention_impl=self.attention_impl,
+                               axis_name=self.axis_name,
+                               tp_size=self.tp_size,
+                               model_axis=self.model_axis,
+                               rope_theta=self.rope_theta,
+                               num_experts=self.num_experts,
+                               expert_axis=self.expert_axis,
+                               ep_size=self.ep_size,
+                               capacity_factor=self.capacity_factor,
+                               name=f"layer{i}")(x, train=train)
+        x = nn.RMSNorm(epsilon=1e-5, dtype=self.dtype, name="rms_f")(x)
+        if self.tp_size > 1:
+            x = copy_to_tp_region(x, self.model_axis)
+        return nn.Dense(self.num_classes // self.tp_size, use_bias=False,
+                        kernel_init=_init, dtype=self.dtype,
+                        name="lm_head")(x)

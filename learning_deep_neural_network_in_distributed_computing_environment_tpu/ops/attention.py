@@ -29,6 +29,25 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def rope(x: jnp.ndarray, pos: jnp.ndarray, theta: float = 10000.0):
+    """Rotary position embedding, rotate-half convention.
+
+    ``x`` [B, L, H, Dh], ``pos`` [L] absolute token positions.  Angles are
+    computed in f32 (bf16 positions lose integer precision past 256) and
+    the result is cast back to ``x.dtype``.  Used by the Llama recipe
+    (``models/llama.py``) via ``models.bert.SelfAttention(rope_theta=...)``.
+    """
+    half = x.shape[-1] // 2
+    freqs = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)  # [Dh/2]
+    ang = pos.astype(jnp.float32)[:, None] * freqs[None, :]         # [L, Dh/2]
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+
 def causal_mask(lq: int, lk: int, q_offset: int = 0, k_offset: int = 0):
     """[lq, lk] bool mask: query at global position q_offset+i may attend
     key positions <= it."""
