@@ -198,16 +198,16 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         # all-gathered per step (gradients reduce-scattered by autodiff).
         # Works for every model family — the model code never sees shards —
         # and composes with tensor parallelism (2-D (fsdp, model) sharding:
-        # ZeRO-3 claims a free dim of each TP-sharded leaf).
-        if (pp > 1 or ep > 1 or cfg.num_experts > 0
-                or cfg.sequence_parallel != "none"):
+        # ZeRO-3 claims a free dim of each TP-sharded leaf) and with
+        # sequence parallelism (B over fsdp, L over seq).
+        if pp > 1 or ep > 1 or cfg.num_experts > 0:
             # MoE even without an expert axis: per-sub-batch routing would
             # change capacity semantics and the psum over fsdp would scale
             # the aux loss by the axis size (same reason as the MoE guard
             # above)
             raise NotImplementedError(
                 f"a '{FSDP_AXIS}' mesh axis does not yet compose with "
-                "pipeline/sequence/expert parallelism or MoE")
+                "pipeline/expert parallelism or MoE")
         if cfg.batch_size % fsdp:
             raise ValueError(
                 f"--batch_size {cfg.batch_size} must be divisible by the "

@@ -341,6 +341,13 @@ class LocalSGDEngine:
             total = total + (lax.psum(ss, axes) if axes else ss)
         return jnp.sqrt(total)
 
+    def _part_axes(self) -> tuple:
+        """Mesh axes along which this device's batch is PARTIAL: the seq
+        axis (one chunk of every sequence) and/or the fsdp axis (a slice
+        of the worker's batch).  Loss denominators and metric sums psum
+        over all of them."""
+        return tuple(a for a in (self.seq_axis, self.fsdp_axis) if a)
+
     def _token_stats(self, out, yb, mb):
         if self.vp_axis is not None:
             from .parallel.tp import vocab_parallel_token_stats
@@ -358,18 +365,19 @@ class LocalSGDEngine:
             {"params": params, "batch_stats": batch_stats}, xb, train=True,
             mutable=["batch_stats", "aux"])
         ce, w, correct = self._token_stats(out, yb, mb)
-        part_axis = self.seq_axis or self.fsdp_axis
-        if part_axis:
+        part_axes = self._part_axes()
+        if part_axes:
             # the batch is partial on this device: under seq parallelism it
             # holds one chunk of every sequence, under FSDP a slice of the
-            # worker's batch.  The loss is the GLOBAL masked mean; returning
-            # the local numerator over the global denominator makes the
-            # cross-device gradient reduction (psum over seq /
-            # reduce-scatter over fsdp) equal grad(global loss).
-            denom = jnp.maximum(lax.psum(w.sum(), part_axis), 1.0)
+            # worker's batch (composable — psum over both).  The loss is
+            # the GLOBAL masked mean; returning the local numerator over
+            # the global denominator makes the cross-device gradient
+            # reduction (psum over seq / reduce-scatter over fsdp) equal
+            # grad(global loss).
+            denom = jnp.maximum(lax.psum(w.sum(), part_axes), 1.0)
             loss = (ce * w).sum() / denom
-            correct = lax.psum(correct, part_axis)
-            total = lax.psum(w.sum(), part_axis)
+            correct = lax.psum(correct, part_axes)
+            total = lax.psum(w.sum(), part_axes)
         else:
             loss = _masked_mean(ce, w)
             total = w.sum()
@@ -411,16 +419,17 @@ class LocalSGDEngine:
                 # combine per-chunk grad contributions; params (and the
                 # Adam update below) stay replicated along seq
                 grads = lax.psum(grads, self.seq_axis)
-                loss = lax.psum(loss, self.seq_axis)
-            elif self.fsdp_axis:
+            if self.fsdp_axis:
                 # sharded leaves' grads arrived reduce-scattered (all_gather
                 # transpose); replicated leaves still need their per-device
-                # partials summed.  The loss metric combines the same way:
-                # global mean = sum of local numerators / psum'd denominator.
+                # partials summed
                 from .parallel.fsdp import reduce_replicated_grads
                 grads = reduce_replicated_grads(grads, self.param_specs,
                                                 self.fsdp_axis)
-                loss = lax.psum(loss, self.fsdp_axis)
+            if self._part_axes():
+                # loss metric: global mean = sum of per-device local
+                # numerators over the shared psum'd denominator
+                loss = lax.psum(loss, self._part_axes())
             updates, new_opt = self.tx.update(grads, opt_state, params)
             new_params = optax.apply_updates(
                 params, jax.tree_util.tree_map(lambda u: -lr * u, updates))
@@ -447,9 +456,8 @@ class LocalSGDEngine:
                 train=False)
             ce, w, correct = self._token_stats(out, yb, mb)
             sums = ((ce * w).sum(), correct, w.sum())
-            part_axis = self.seq_axis or self.fsdp_axis
-            if part_axis:
-                sums = lax.psum(sums, part_axis)
+            if self._part_axes():
+                sums = lax.psum(sums, self._part_axes())
             return carry, sums
 
         return train_step, eval_step
@@ -555,15 +563,16 @@ class LocalSGDEngine:
 
     def _pack_specs(self, shapes_key=None):
         """(x, y, m) PartitionSpecs for one pack.  Token tasks under
-        sequence parallelism additionally shard the sequence dim of x
-        [N,S,B,L] and y [N,S,B,L] over the seq axis; the per-example mask m
-        [N,S,B] stays data-only.  Under FSDP the batch dim (index 2) of all
-        three shards over the fsdp axis — it is an inner data axis."""
+        sequence parallelism shard the sequence dim of x [N,S,B,L] and y
+        [N,S,B,L] over the seq axis; under FSDP the batch dim (index 2) of
+        all three shards over the fsdp axis (an inner data axis); the two
+        compose (B over fsdp, L over seq)."""
+        bdim = self.fsdp_axis  # None or the axis name
         if self.seq_axis:
-            tok = P(DATA_AXIS, None, None, self.seq_axis)
-            return (tok, tok, self._spec)
-        if self.fsdp_axis:
-            return (P(DATA_AXIS, None, self.fsdp_axis),) * 3
+            tok = P(DATA_AXIS, None, bdim, self.seq_axis)
+            return (tok, tok, P(DATA_AXIS, None, bdim))
+        if bdim:
+            return (P(DATA_AXIS, None, bdim),) * 3
         return (self._spec,) * 3
 
     def _inner_specs(self):

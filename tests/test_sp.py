@@ -101,3 +101,57 @@ class TestDriverSequenceParallel:
     def test_requires_seq_axis(self, devices):
         with pytest.raises(ValueError, match="seq"):
             self._run(devices, "ring", {"data": 8})
+
+
+def _composition_run(devices, mesh_axes, model="bert_tiny",
+                     dataset="synthetic_mlm", seed=7, **extra):
+    """Shared driver harness for the composition classes below."""
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu.mesh import build_mesh
+    cfg = Config(model=model, dataset=dataset, epochs_global=2,
+                 epochs_local=1, batch_size=8, limit_train_samples=128,
+                 limit_eval_samples=32, compute_dtype="float32",
+                 augment=False, aggregation_by="weights", seed=seed, **extra)
+    return train_global(cfg, mesh=build_mesh(mesh_axes, devices),
+                        progress=False)
+
+
+class TestSeqTensorComposition:
+    """SP x TP: ring attention over 'seq' with Megatron head/FFN shards
+    over 'model' in the same step (heads are local to each model shard;
+    the k/v ring rotation and the TP psums ride different axes)."""
+
+    def test_matches_dense_run(self, devices):
+        dense = _composition_run(devices[:2], {"data": 2})
+        both = _composition_run(devices[:8],
+                                {"data": 2, "seq": 2, "model": 2},
+                                sequence_parallel="ring")
+        np.testing.assert_allclose(both["global_train_losses"],
+                                   dense["global_train_losses"], rtol=2e-3)
+
+    def test_llama_causal_matches_dense(self, devices):
+        kw = dict(model="llama_tiny", dataset="synthetic_lm", seed=8)
+        dense = _composition_run(devices[:2], {"data": 2}, **kw)
+        both = _composition_run(devices[:8],
+                                {"data": 2, "seq": 2, "model": 2},
+                                sequence_parallel="ring", **kw)
+        np.testing.assert_allclose(both["global_train_losses"],
+                                   dense["global_train_losses"], rtol=2e-3)
+
+
+class TestSeqFsdpComposition:
+    """SP x FSDP: L over 'seq', B over 'fsdp' in the same step — the loss
+    denominator and metric sums psum over BOTH partial-batch axes, grads
+    psum over seq then reduce-scatter over fsdp."""
+
+    def test_matches_dense_run(self, devices):
+        dense = _composition_run(devices[:2], {"data": 2}, seed=9)
+        both = _composition_run(devices[:8],
+                                {"data": 2, "fsdp": 2, "seq": 2},
+                                sequence_parallel="ring", seed=9)
+        np.testing.assert_allclose(both["global_train_losses"],
+                                   dense["global_train_losses"], rtol=2e-3)
+        specs = [str(l.sharding.spec) for l in
+                 jax.tree_util.tree_leaves(both["state"].params)]
+        assert any("fsdp" in s for s in specs)
