@@ -83,6 +83,7 @@ class Config:
     attention_impl: str = "dense"    # dense | flash (Pallas kernel; bert)
     pp_microbatches: int = 0         # GPipe microbatches (0 => pipe size)
     num_experts: int = 0             # >0 => MoE FFN in bert/gpt layers
+    num_kv_heads: int = 0            # >0 => GQA (llama_* models)
     expert_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01     # load-balance aux loss coefficient
     # Streamed input pipeline: >0 = feed the round in chunks of this many
@@ -198,6 +199,9 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--pp_microbatches", type=int, default=d.pp_microbatches,
                    help="GPipe microbatches when the mesh has a pipe axis "
                         "(0 = pipe size)")
+    p.add_argument("--num_kv_heads", type=int, default=d.num_kv_heads,
+                   help="grouped-query attention kv-head count "
+                        "(llama_* models; 0 = multi-head)")
     p.add_argument("--num_experts", type=int, default=d.num_experts,
                    help="MoE experts per bert/gpt layer (0 = dense FFN); "
                         "shard with an 'expert' mesh axis")

@@ -144,6 +144,13 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         train_kw.update(pipeline_axis=PIPE_AXIS, pp_size=pp,
                         num_microbatches=cfg.pp_microbatches)
         param_specs_fn = partial(pp_param_specs, axis=PIPE_AXIS)
+    if cfg.num_kv_heads > 0:
+        # grouped-query attention (models/llama.py; the Llama-2/3 recipe)
+        if not cfg.model.startswith("llama"):
+            raise ValueError(
+                f"--num_kv_heads applies to llama_* models; got --model "
+                f"{cfg.model}")
+        base_kw.update(num_kv_heads=cfg.num_kv_heads)
     ep = int(mesh.shape.get(EXPERT_AXIS, 1))
     if cfg.num_experts > 0:
         # MoE FFN (models/moe.py); with an 'expert' mesh axis the stacked

@@ -42,6 +42,10 @@ class SelfAttention(nn.Module):
     causal: bool = False           # autoregressive masking (decoder models)
     rope_theta: Optional[float] = None  # apply RoPE to q/k (Llama recipe)
     use_bias: bool = True          # False => no qkv / output biases (Llama)
+    num_kv_heads: Optional[int] = None  # < num_heads => grouped-query
+    #                                     attention (separate q / kv
+    #                                     projections, kv heads shared by
+    #                                     num_heads // num_kv_heads queries)
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -50,10 +54,31 @@ class SelfAttention(nn.Module):
         head_dim = d // self.num_heads
         h_local = self.num_heads // self.tp_size
         x_in = copy_to_tp_region(x, self.model_axis)
-        qkv = nn.DenseGeneral((3, h_local, head_dim), kernel_init=_init,
-                              use_bias=self.use_bias, dtype=self.dtype,
-                              name="qkv")(x_in)
-        q, k, v = (qkv[..., 0, :, :], qkv[..., 1, :, :], qkv[..., 2, :, :])
+        # falsy num_kv_heads (None or the config's 0 sentinel) means MHA
+        gqa = bool(self.num_kv_heads) and self.num_kv_heads != self.num_heads
+        if gqa:
+            if self.num_heads % self.num_kv_heads:
+                raise ValueError(
+                    f"num_heads {self.num_heads} not divisible by "
+                    f"num_kv_heads {self.num_kv_heads}")
+            if self.num_kv_heads % self.tp_size:
+                raise ValueError(
+                    f"num_kv_heads {self.num_kv_heads} not divisible by "
+                    f"tp_size {self.tp_size}")
+            kv_local = self.num_kv_heads // self.tp_size
+            q = nn.DenseGeneral((h_local, head_dim), kernel_init=_init,
+                                use_bias=self.use_bias, dtype=self.dtype,
+                                name="q")(x_in)
+            kv = nn.DenseGeneral((2, kv_local, head_dim), kernel_init=_init,
+                                 use_bias=self.use_bias, dtype=self.dtype,
+                                 name="kv")(x_in)
+            k, v = kv[..., 0, :, :], kv[..., 1, :, :]
+        else:
+            qkv = nn.DenseGeneral((3, h_local, head_dim), kernel_init=_init,
+                                  use_bias=self.use_bias, dtype=self.dtype,
+                                  name="qkv")(x_in)
+            q, k, v = (qkv[..., 0, :, :], qkv[..., 1, :, :],
+                       qkv[..., 2, :, :])
         if self.rope_theta is not None:
             from jax import lax
             from ..ops.attention import rope
@@ -65,6 +90,13 @@ class SelfAttention(nn.Module):
                 pos = pos + lax.axis_index(self.axis_name) * x.shape[1]
             q = rope(q, pos, self.rope_theta)
             k = rope(k, pos, self.rope_theta)
+        if gqa:
+            # broadcast each kv head to its query group AFTER RoPE (cheaper
+            # to rotate kv_local heads); every attention impl — dense,
+            # flash kernel, ring/Ulysses — then sees equal head counts
+            rep = h_local // (self.num_kv_heads // self.tp_size)
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
         out = attend(q, k, v, mask=mask, impl=self.attention_impl,
                      axis_name=self.axis_name, causal=self.causal)
         y = nn.DenseGeneral(d, axis=(-2, -1), kernel_init=_init,
@@ -285,6 +317,12 @@ def _tp_parts(names: list, ndim: int, axis: str):
     """
     parts = [None] * ndim
     if "qkv" in names:
+        parts[2 if ndim == 4 else 1] = axis
+    elif "q" in names:
+        # GQA query projection: kernel [H, heads, hd] / bias [heads, hd]
+        parts[1 if ndim == 3 else 0] = axis
+    elif "kv" in names:
+        # GQA kv projection: kernel [H, 2, kv_heads, hd] / bias [2, kv, hd]
         parts[2 if ndim == 4 else 1] = axis
     elif "out" in names and ndim == 3:   # kernel [heads, hd, H]
         parts[0] = axis

@@ -50,6 +50,7 @@ class LlamaBlock(nn.Module):
     tp_size: int = 1
     model_axis: Optional[str] = None
     rope_theta: float = 10000.0
+    num_kv_heads: Optional[int] = None   # < num_heads => GQA
     num_experts: int = 0
     expert_axis: Optional[str] = None
     ep_size: int = 1
@@ -64,6 +65,7 @@ class LlamaBlock(nn.Module):
                           axis_name=self.axis_name, tp_size=self.tp_size,
                           model_axis=self.model_axis, causal=True,
                           rope_theta=self.rope_theta, use_bias=False,
+                          num_kv_heads=self.num_kv_heads,
                           name="attn")(norm("rms1")(x))
         x = x + a
         f = norm("rms2")(x)
@@ -99,6 +101,7 @@ class _ScanLlamaBlock(nn.Module):
     tp_size: int = 1
     model_axis: Optional[str] = None
     rope_theta: float = 10000.0
+    num_kv_heads: Optional[int] = None
     train: bool = False
 
     @nn.compact
@@ -107,7 +110,8 @@ class _ScanLlamaBlock(nn.Module):
                        attention_impl=self.attention_impl,
                        axis_name=self.axis_name, tp_size=self.tp_size,
                        model_axis=self.model_axis,
-                       rope_theta=self.rope_theta, name="layer")(
+                       rope_theta=self.rope_theta,
+                       num_kv_heads=self.num_kv_heads, name="layer")(
                            x, train=self.train)
         return y, None
 
@@ -122,6 +126,7 @@ class LlamaForCausalLM(nn.Module):
     num_heads: int = 16
     ffn_dim: int = 2816            # SwiGLU hidden (~2.75x hidden)
     rope_theta: float = 10000.0
+    num_kv_heads: Optional[int] = None   # < num_heads => GQA (Llama-2/3)
     dtype: Any = jnp.float32
     attention_impl: str = "dense"
     axis_name: Optional[str] = None
@@ -163,7 +168,8 @@ class LlamaForCausalLM(nn.Module):
                 num_heads=self.num_heads, ffn_dim=self.ffn_dim,
                 dtype=self.dtype, attention_impl=self.attention_impl,
                 axis_name=self.axis_name, tp_size=self.tp_size,
-                model_axis=self.model_axis, rope_theta=self.rope_theta)
+                model_axis=self.model_axis, rope_theta=self.rope_theta,
+                num_kv_heads=self.num_kv_heads)
         else:
             for i in range(self.num_layers):
                 x = LlamaBlock(self.num_heads, self.ffn_dim,
@@ -173,6 +179,7 @@ class LlamaForCausalLM(nn.Module):
                                tp_size=self.tp_size,
                                model_axis=self.model_axis,
                                rope_theta=self.rope_theta,
+                               num_kv_heads=self.num_kv_heads,
                                num_experts=self.num_experts,
                                expert_axis=self.expert_axis,
                                ep_size=self.ep_size,

@@ -124,3 +124,81 @@ class TestDriverLlama:
                   sequence_parallel="ring")
         np.testing.assert_allclose(sp["global_train_losses"],
                                    dense["global_train_losses"], rtol=2e-3)
+
+
+class TestGQA:
+    """Grouped-query attention: separate q / kv projections, kv heads
+    shared across query groups, broadcast after RoPE."""
+
+    def _model(self, **kw):
+        return get_model("llama_tiny", num_classes=1000, num_kv_heads=2,
+                         **kw)
+
+    def test_param_structure_and_count(self):
+        m = self._model()
+        vs = jax.eval_shape(
+            lambda: m.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32)))
+        names = [jax.tree_util.keystr(p) for p, _ in
+                 jax.tree_util.tree_leaves_with_path(vs["params"])]
+        assert any("['q']" in n for n in names)
+        assert any("['kv']" in n for n in names)
+        assert not any("qkv" in n for n in names)
+        n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(vs["params"]))
+        # attn per layer: q h*h + kv 2*(kv/heads)*h*h + out h*h
+        h, f, L, v, kvfrac = 64, 176, 2, 1000, 2 / 4
+        attn = h * h + 2 * int(kvfrac * h * h) + h * h
+        assert n == 2 * v * h + L * (attn + 3 * h * f + 2 * h) + h
+
+    def test_causality_and_finite(self):
+        m = self._model()
+        x = jnp.asarray(np.random.default_rng(0).integers(2, 100, (2, 16)),
+                        jnp.int32)
+        v = jax.jit(lambda k: m.init(k, x))(jax.random.key(0))
+        out = m.apply(v, x)
+        assert np.isfinite(np.asarray(out)).all()
+        x2 = x.at[:, 8:].set(7)
+        out2 = m.apply(v, x2)
+        np.testing.assert_allclose(out[:, :8], out2[:, :8], atol=2e-5)
+
+    def test_kv_heads_must_divide(self):
+        m = get_model("llama_tiny", num_classes=1000, num_kv_heads=3)
+        x = jnp.zeros((1, 8), jnp.int32)
+        with pytest.raises(ValueError, match="num_kv_heads"):
+            m.init(jax.random.key(0), x)
+
+    def test_gqa_tp_matches_single_device(self, devices):
+        """GQA under TP: q sharded by head, kv by kv-head (bert._tp_parts
+        'q'/'kv' patterns); sharded forward == dense forward."""
+        from jax.sharding import Mesh, PartitionSpec as P
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.models.bert import (
+            tp_param_specs,
+        )
+        dense = self._model()
+        tp = self._model(tp_size=2, model_axis="model")
+        x = jnp.asarray(np.random.default_rng(1).integers(2, 100, (2, 16)),
+                        jnp.int32)
+        params = dense.init(jax.random.key(1), x)["params"]
+        specs = tp_param_specs(params, axis="model")
+        mesh = Mesh(np.array(devices[:2]), ("model",))
+        f = jax.jit(jax.shard_map(
+            lambda p, x: tp.apply({"params": p}, x, train=False),
+            mesh=mesh, in_specs=(specs, P()),
+            out_specs=P(None, None, "model")))
+        np.testing.assert_allclose(
+            f(params, x),
+            dense.apply({"params": params}, x, train=False), atol=2e-4)
+
+    def test_gqa_via_driver_flag(self, devices):
+        """--num_kv_heads plumbs through the driver (TP mesh) and trains."""
+        res = _run(devices[:4], {"data": 2, "model": 2}, num_kv_heads=2)
+        assert np.isfinite(res["global_train_losses"]).all()
+
+    def test_gqa_flag_rejected_for_non_llama(self, devices):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+        cfg = Config(model="bert_tiny", dataset="synthetic_mlm",
+                     batch_size=8, limit_train_samples=64,
+                     limit_eval_samples=16, augment=False, num_kv_heads=2)
+        mesh = build_mesh({"data": 2}, devices[:2])
+        with pytest.raises(ValueError, match="num_kv_heads"):
+            train_global(cfg, mesh=mesh, progress=False)
