@@ -91,3 +91,20 @@ class TestStreamedRound:
                                 mesh=mesh, progress=False)
         np.testing.assert_allclose(streamed["global_train_losses"],
                                    packed["global_train_losses"], rtol=1e-5)
+
+    def test_streamed_with_fsdp(self, devices):
+        """The streamed round must compose with ZeRO-3 shards (the inner
+        carry and chunk programs use the fsdp specs, params gathered
+        per step) and match the packed FSDP round."""
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.mesh import build_mesh
+        kw = dict(epochs_local=1, batch_size=8, limit_train_samples=160,
+                  limit_eval_samples=32, seed=12)
+        mesh = build_mesh({"data": 2, "fsdp": 2}, devices[:4])
+        walls = lambda e: np.ones(2)
+        packed = train_global(self._cfg(**kw), mesh=mesh, progress=False,
+                              simulated_round_durations=walls)
+        streamed = train_global(self._cfg(stream_chunk_steps=2, **kw),
+                                mesh=mesh, progress=False,
+                                simulated_round_durations=walls)
+        np.testing.assert_allclose(streamed["global_train_losses"],
+                                   packed["global_train_losses"], rtol=1e-5)
