@@ -14,7 +14,9 @@ Per-step FLOPs come from XLA's cost model on the exact compiled executable
 
 Methodology (see memory: chain K steps + one fetch): each sample chains K
 data-dependent steps and fetches once — block_until_ready alone lies on
-remote-relay PJRT backends; median of 3 chains damps relay variance.
+remote-relay PJRT backends.  3 chains, median; if they disagree by > 30%
+(transient relay slow windows), 4 more chains are sampled and the median
+is taken over all 7.
 """
 
 from __future__ import annotations
@@ -34,14 +36,25 @@ def _chain_rate(step, state, steps: int, chains: int = 3) -> float:
     """Median steps/sec over ``chains`` chains of ``steps`` dependent steps.
 
     State carries forward across chains (never reused after a call) so the
-    step may donate its input buffers."""
+    step may donate its input buffers.  If the chains disagree by > 30%
+    (observed: the relay link has transient slow windows that hit short
+    steps hardest), four more chains are sampled and the median is taken
+    over all of them."""
     rates = []
-    for _ in range(chains):
+
+    def one_chain(state):
         t0 = time.perf_counter()
         for _ in range(steps):
             state = step(state)
         jax_fetch(state)
         rates.append(steps / (time.perf_counter() - t0))
+        return state
+
+    for _ in range(chains):
+        state = one_chain(state)
+    if max(rates) > 1.3 * min(rates):
+        for _ in range(4):
+            state = one_chain(state)
     rates.sort()
     return rates[len(rates) // 2]
 
@@ -145,8 +158,8 @@ def measure_flash_vs_dense() -> dict:
     chip: forward-only chains AND a train step (fwd + the blockwise Pallas
     backward vs fwd + dense backward).  VERDICT r1 asked for the honest
     record: flash ties at L=512 where the score matrix is cheap and wins
-    increasingly from L=2048 up as dense goes O(L^2)-HBM-bound (~42x fwd,
-    ~19x fwd+bwd at L=8192)."""
+    increasingly from L=2048 up as dense goes O(L^2)-HBM-bound (29-42x fwd,
+    18-24x fwd+bwd at L=8192 across runs)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -157,14 +170,24 @@ def measure_flash_vs_dense() -> dict:
         o = f(arg)
         jax_fetch(o)
         samples = []
-        for _ in range(3):
+
+        def one(n=steps):
             t0 = time.perf_counter()
             o = arg
-            for _ in range(steps):
+            for _ in range(n):
                 o = f(o)  # data-dependent chain
             jax_fetch(o)
-            samples.append((time.perf_counter() - t0) / steps)
-        return sorted(samples)[1]
+            samples.append((time.perf_counter() - t0) / n)
+
+        for _ in range(3):
+            one()
+        if max(samples) > 1.3 * min(samples):
+            # transient relay slow window: resample (same policy as
+            # _chain_rate) and take the median over all samples
+            for _ in range(4):
+                one()
+        samples.sort()
+        return samples[len(samples) // 2]
 
     out = {}
     rng = np.random.default_rng(0)
