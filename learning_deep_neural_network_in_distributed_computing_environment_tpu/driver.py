@@ -137,7 +137,9 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         if cfg.sequence_parallel != "none":
             raise NotImplementedError(
                 "pipeline parallelism does not yet compose with "
-                "--sequence_parallel")
+                "--sequence_parallel (the ring rotation inside the GPipe "
+                "schedule reaches a mismatched collective schedule; "
+                "verified to abort rather than run)")
         from functools import partial
         from .parallel.pp import pp_param_specs
         base_kw.update(scan_layers=True)
@@ -159,19 +161,27 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             raise ValueError(
                 f"--num_experts applies to attention models (bert_*/gpt_*/vit_*/llama_*); "
                 f"got --model {cfg.model}")
-        if (pp > 1 or int(mesh.shape.get(MODEL_AXIS, 1)) > 1
+        if (int(mesh.shape.get(MODEL_AXIS, 1)) > 1
                 or cfg.sequence_parallel != "none"):
             raise NotImplementedError(
-                "MoE does not yet compose with pipeline, tensor, or "
-                "sequence parallelism (per-chunk routing would change the "
+                "MoE does not yet compose with tensor or sequence "
+                "parallelism (per-chunk routing would change the "
                 "capacity and aux-loss semantics)")
         base_kw.update(num_experts=cfg.num_experts,
                        capacity_factor=cfg.expert_capacity_factor)
         if ep > 1:
             from functools import partial
-            from .models.moe import ep_param_specs
+            from .models.moe import ep_param_specs, pp_ep_param_specs
             train_kw.update(expert_axis=EXPERT_AXIS, ep_size=ep)
-            param_specs_fn = partial(ep_param_specs, axis=EXPERT_AXIS)
+            if pp > 1:
+                # MoE x PP x EP: the stacked layer axis shards over 'pipe'
+                # AND the expert stacks (dim 1 behind the layer dim) over
+                # 'expert'
+                param_specs_fn = partial(pp_ep_param_specs,
+                                         pipe_axis=PIPE_AXIS,
+                                         axis=EXPERT_AXIS)
+            else:
+                param_specs_fn = partial(ep_param_specs, axis=EXPERT_AXIS)
     elif ep > 1:
         raise ValueError(
             f"mesh has an '{EXPERT_AXIS}' axis but --num_experts is 0")

@@ -52,6 +52,10 @@ class SelfAttention(nn.Module):
         from ..ops.attention import attend
         d = x.shape[-1]
         head_dim = d // self.num_heads
+        if self.num_heads % self.tp_size:
+            raise ValueError(
+                f"num_heads {self.num_heads} not divisible by tp_size "
+                f"{self.tp_size} (head-sharded tensor parallelism)")
         h_local = self.num_heads // self.tp_size
         x_in = copy_to_tp_region(x, self.model_axis)
         # falsy num_kv_heads (None or the config's 0 sentinel) means MHA
@@ -90,13 +94,11 @@ class SelfAttention(nn.Module):
                 pos = pos + lax.axis_index(self.axis_name) * x.shape[1]
             q = rope(q, pos, self.rope_theta)
             k = rope(k, pos, self.rope_theta)
-        if gqa:
-            # broadcast each kv head to its query group AFTER RoPE (cheaper
-            # to rotate kv_local heads); every attention impl — dense,
-            # flash kernel, ring/Ulysses — then sees equal head counts
-            rep = h_local // (self.num_kv_heads // self.tp_size)
-            k = jnp.repeat(k, rep, axis=2)
-            v = jnp.repeat(v, rep, axis=2)
+        # GQA K/V are passed GROUPED ([B, L, kv_local, D]) straight into
+        # attend: every impl — dense (grouped einsum), flash kernel
+        # (grouped block specs), ring (rep-x smaller rotating blocks),
+        # Ulysses — consumes them without a repeat-to-full-heads expansion,
+        # so the K/V bandwidth saving GQA exists for actually materializes
         out = attend(q, k, v, mask=mask, impl=self.attention_impl,
                      axis_name=self.axis_name, causal=self.causal)
         y = nn.DenseGeneral(d, axis=(-2, -1), kernel_init=_init,
@@ -123,7 +125,7 @@ class EncoderLayer(nn.Module):
     capacity_factor: float = 1.25
 
     @nn.compact
-    def __call__(self, x, mask=None, *, train: bool = False):
+    def __call__(self, x, mask=None, *, train: bool = False, aux_scale=1.0):
         # post-LN (original BERT): sublayer -> residual -> LayerNorm
         a = SelfAttention(self.num_heads, dtype=self.dtype,
                           attention_impl=self.attention_impl,
@@ -138,8 +140,13 @@ class EncoderLayer(nn.Module):
             f = MoEFFN(self.num_experts, self.ffn_dim,
                        capacity_factor=self.capacity_factor,
                        dtype=self.dtype, expert_axis=self.expert_axis,
-                       ep_size=self.ep_size, name="moe")(x, train=train)
+                       ep_size=self.ep_size, name="moe")(
+                           x, train=train, aux_scale=aux_scale)
         else:
+            if self.ffn_dim % self.tp_size:
+                raise ValueError(
+                    f"ffn_dim {self.ffn_dim} not divisible by tp_size "
+                    f"{self.tp_size} (column-parallel FFN)")
             f_in = copy_to_tp_region(x, self.model_axis)
             f = nn.Dense(self.ffn_dim // self.tp_size, kernel_init=_init,
                          dtype=self.dtype, name="ffn_in")(f_in)
@@ -154,7 +161,10 @@ class EncoderLayer(nn.Module):
 
 
 class _ScanLayer(nn.Module):
-    """carry-API adapter so ``nn.scan`` can stack EncoderLayers."""
+    """carry-API adapter so ``nn.scan`` can stack EncoderLayers.  The
+    second (broadcast) argument is the MoE aux-loss scale — None outside
+    the GPipe schedule, bubble-masked ``valid / num_microbatches`` inside
+    it (parallel/pp.py)."""
 
     num_heads: int
     ffn_dim: int
@@ -163,15 +173,26 @@ class _ScanLayer(nn.Module):
     axis_name: Optional[str] = None
     tp_size: int = 1
     model_axis: Optional[str] = None
+    num_experts: int = 0
+    expert_axis: Optional[str] = None
+    ep_size: int = 1
+    capacity_factor: float = 1.25
     train: bool = False
 
     @nn.compact
-    def __call__(self, x, _):
+    def __call__(self, x, aux_scale):
         y = EncoderLayer(self.num_heads, self.ffn_dim, dtype=self.dtype,
                          attention_impl=self.attention_impl,
                          axis_name=self.axis_name, tp_size=self.tp_size,
-                         model_axis=self.model_axis, name="layer")(
-                             x, train=self.train)
+                         model_axis=self.model_axis,
+                         num_experts=self.num_experts,
+                         expert_axis=self.expert_axis,
+                         ep_size=self.ep_size,
+                         capacity_factor=self.capacity_factor,
+                         name="layer")(
+                             x, train=self.train,
+                             aux_scale=1.0 if aux_scale is None
+                             else aux_scale)
         return y, None
 
 
@@ -179,17 +200,23 @@ def apply_scanned_stack(scan_layer_cls, x, *, num_layers: int, pp_size: int,
                         pipeline_axis, num_microbatches: int, train: bool,
                         **layer_kw):
     """``nn.scan`` the stacked ``layers`` collection and run it plain or as
-    a GPipe schedule — shared by BERT/GPT/ViT.  The stacked collection's
-    leading [num_layers] axis is what ``pp_param_specs`` shards over
-    ``pipe``; with a ``pipeline_axis`` this device applies its
-    ``num_layers // pp_size`` local layers per schedule step."""
+    a GPipe schedule — shared by BERT/GPT/ViT/Llama.  The stacked
+    collection's leading [num_layers] axis is what ``pp_param_specs``
+    shards over ``pipe``; with a ``pipeline_axis`` this device applies its
+    ``num_layers // pp_size`` local layers per schedule step.
+
+    MoE composes: ``variable_axes['aux'] = 0`` stacks each layer's sown
+    load-balance loss along the scan axis (the engine sums leaves fully),
+    and the broadcast second argument carries the GPipe bubble mask down
+    to ``MoEFFN.aux_scale``."""
     if num_layers % pp_size:
         raise ValueError(f"num_layers {num_layers} not divisible "
                          f"by pp_size {pp_size}")
     n_local = num_layers // pp_size
     scanned = nn.scan(
-        scan_layer_cls, variable_axes={"params": 0},
-        split_rngs={"params": True}, length=n_local)(
+        scan_layer_cls, variable_axes={"params": 0, "aux": 0},
+        split_rngs={"params": True}, in_axes=nn.broadcast,
+        length=n_local)(
             train=train, name="layers", **layer_kw)
     if pipeline_axis is None:
         return scanned(x, None)[0]
@@ -255,11 +282,6 @@ class BertForMLM(nn.Module):
         x = nn.LayerNorm(epsilon=1e-12, name="ln_emb")(tok + pos)
         x = jnp.asarray(x, self.dtype)
         if self.scan_layers:
-            if self.num_experts:
-                raise NotImplementedError(
-                    "MoE layers do not yet compose with scan_layers/"
-                    "pipeline parallelism (the sown aux loss would need "
-                    "lifting through nn.scan)")
             x = self._encode_scanned(x, train)
         else:
             for i in range(self.num_layers):
@@ -300,7 +322,9 @@ class BertForMLM(nn.Module):
             num_heads=self.num_heads, ffn_dim=self.ffn_dim,
             dtype=self.dtype, attention_impl=self.attention_impl,
             axis_name=self.axis_name, tp_size=self.tp_size,
-            model_axis=self.model_axis)
+            model_axis=self.model_axis, num_experts=self.num_experts,
+            expert_axis=self.expert_axis, ep_size=self.ep_size,
+            capacity_factor=self.capacity_factor)
 
 
 def _tp_parts(names: list, ndim: int, axis: str):

@@ -52,7 +52,7 @@ class GPTBlock(nn.Module):
     capacity_factor: float = 1.25
 
     @nn.compact
-    def __call__(self, x, *, train: bool = False):
+    def __call__(self, x, *, train: bool = False, aux_scale=1.0):
         h = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln1")(x)
         a = SelfAttention(self.num_heads, dtype=self.dtype,
                           attention_impl=self.attention_impl,
@@ -66,8 +66,13 @@ class GPTBlock(nn.Module):
             f = MoEFFN(self.num_experts, self.ffn_dim,
                        capacity_factor=self.capacity_factor,
                        dtype=self.dtype, expert_axis=self.expert_axis,
-                       ep_size=self.ep_size, name="moe")(f, train=train)
+                       ep_size=self.ep_size, name="moe")(
+                           f, train=train, aux_scale=aux_scale)
         else:
+            if self.ffn_dim % self.tp_size:
+                raise ValueError(
+                    f"ffn_dim {self.ffn_dim} not divisible by tp_size "
+                    f"{self.tp_size} (column-parallel FFN)")
             f = copy_to_tp_region(f, self.model_axis)
             f = nn.Dense(self.ffn_dim // self.tp_size, kernel_init=_init,
                          dtype=self.dtype, name="ffn_in")(f)
@@ -81,7 +86,9 @@ class GPTBlock(nn.Module):
 
 
 class _ScanBlock(nn.Module):
-    """carry-API adapter so ``nn.scan`` can stack GPTBlocks."""
+    """carry-API adapter so ``nn.scan`` can stack GPTBlocks.  Second
+    (broadcast) arg: MoE aux-loss scale (None => 1.0; the GPipe schedule
+    passes its bubble mask — parallel/pp.py)."""
 
     num_heads: int
     ffn_dim: int
@@ -90,15 +97,23 @@ class _ScanBlock(nn.Module):
     axis_name: Optional[str] = None
     tp_size: int = 1
     model_axis: Optional[str] = None
+    num_experts: int = 0
+    expert_axis: Optional[str] = None
+    ep_size: int = 1
+    capacity_factor: float = 1.25
     train: bool = False
 
     @nn.compact
-    def __call__(self, x, _):
+    def __call__(self, x, aux_scale):
         y = GPTBlock(self.num_heads, self.ffn_dim, dtype=self.dtype,
                      attention_impl=self.attention_impl,
                      axis_name=self.axis_name, tp_size=self.tp_size,
-                     model_axis=self.model_axis, name="layer")(
-                         x, train=self.train)
+                     model_axis=self.model_axis,
+                     num_experts=self.num_experts,
+                     expert_axis=self.expert_axis, ep_size=self.ep_size,
+                     capacity_factor=self.capacity_factor, name="layer")(
+                         x, train=self.train,
+                         aux_scale=1.0 if aux_scale is None else aux_scale)
         return y, None
 
 
@@ -151,11 +166,6 @@ class GPTForCausalLM(nn.Module):
                        dtype=self.dtype, name="pos_emb")(pos_ids[None, :])
         x = jnp.asarray(tok + pos, self.dtype)
         if self.scan_layers:
-            if self.num_experts:
-                raise NotImplementedError(
-                    "MoE blocks do not yet compose with scan_layers/"
-                    "pipeline parallelism (the sown aux loss would need "
-                    "lifting through nn.scan)")
             x = self._decode_scanned(x, train)
         else:
             for i in range(self.num_layers):
@@ -181,4 +191,6 @@ class GPTForCausalLM(nn.Module):
             num_heads=self.num_heads, ffn_dim=self.ffn_dim,
             dtype=self.dtype, attention_impl=self.attention_impl,
             axis_name=self.axis_name, tp_size=self.tp_size,
-            model_axis=self.model_axis)
+            model_axis=self.model_axis, num_experts=self.num_experts,
+            expert_axis=self.expert_axis, ep_size=self.ep_size,
+            capacity_factor=self.capacity_factor)

@@ -57,7 +57,7 @@ class LlamaBlock(nn.Module):
     capacity_factor: float = 1.25
 
     @nn.compact
-    def __call__(self, x, *, train: bool = False):
+    def __call__(self, x, *, train: bool = False, aux_scale=1.0):
         norm = lambda name: nn.RMSNorm(epsilon=1e-5, dtype=self.dtype,
                                        name=name)
         a = SelfAttention(self.num_heads, dtype=self.dtype,
@@ -74,8 +74,13 @@ class LlamaBlock(nn.Module):
             f = MoEFFN(self.num_experts, self.ffn_dim,
                        capacity_factor=self.capacity_factor,
                        dtype=self.dtype, expert_axis=self.expert_axis,
-                       ep_size=self.ep_size, name="moe")(f, train=train)
+                       ep_size=self.ep_size, name="moe")(
+                           f, train=train, aux_scale=aux_scale)
         else:
+            if self.ffn_dim % self.tp_size:
+                raise ValueError(
+                    f"ffn_dim {self.ffn_dim} not divisible by tp_size "
+                    f"{self.tp_size} (column-parallel SwiGLU)")
             f_in = copy_to_tp_region(f, self.model_axis)
             gate = nn.Dense(self.ffn_dim // self.tp_size, use_bias=False,
                             kernel_init=_init, dtype=self.dtype,
@@ -91,7 +96,9 @@ class LlamaBlock(nn.Module):
 
 
 class _ScanLlamaBlock(nn.Module):
-    """carry-API adapter so ``nn.scan`` can stack LlamaBlocks."""
+    """carry-API adapter so ``nn.scan`` can stack LlamaBlocks.  Second
+    (broadcast) arg: MoE aux-loss scale (None => 1.0; the GPipe schedule
+    passes its bubble mask — parallel/pp.py)."""
 
     num_heads: int
     ffn_dim: int
@@ -102,17 +109,26 @@ class _ScanLlamaBlock(nn.Module):
     model_axis: Optional[str] = None
     rope_theta: float = 10000.0
     num_kv_heads: Optional[int] = None
+    num_experts: int = 0
+    expert_axis: Optional[str] = None
+    ep_size: int = 1
+    capacity_factor: float = 1.25
     train: bool = False
 
     @nn.compact
-    def __call__(self, x, _):
+    def __call__(self, x, aux_scale):
         y = LlamaBlock(self.num_heads, self.ffn_dim, dtype=self.dtype,
                        attention_impl=self.attention_impl,
                        axis_name=self.axis_name, tp_size=self.tp_size,
                        model_axis=self.model_axis,
                        rope_theta=self.rope_theta,
-                       num_kv_heads=self.num_kv_heads, name="layer")(
-                           x, train=self.train)
+                       num_kv_heads=self.num_kv_heads,
+                       num_experts=self.num_experts,
+                       expert_axis=self.expert_axis, ep_size=self.ep_size,
+                       capacity_factor=self.capacity_factor, name="layer")(
+                           x, train=self.train,
+                           aux_scale=1.0 if aux_scale is None
+                           else aux_scale)
         return y, None
 
 
@@ -155,11 +171,6 @@ class LlamaForCausalLM(nn.Module):
                      dtype=self.dtype, name="tok_emb")(input_ids)
         # no position table: RoPE inside attention carries all position info
         if self.scan_layers:
-            if self.num_experts:
-                raise NotImplementedError(
-                    "MoE blocks do not yet compose with scan_layers/"
-                    "pipeline parallelism (the sown aux loss would need "
-                    "lifting through nn.scan)")
             from .bert import apply_scanned_stack
             x = apply_scanned_stack(
                 _ScanLlamaBlock, x, num_layers=self.num_layers,
@@ -169,7 +180,10 @@ class LlamaForCausalLM(nn.Module):
                 dtype=self.dtype, attention_impl=self.attention_impl,
                 axis_name=self.axis_name, tp_size=self.tp_size,
                 model_axis=self.model_axis, rope_theta=self.rope_theta,
-                num_kv_heads=self.num_kv_heads)
+                num_kv_heads=self.num_kv_heads,
+                num_experts=self.num_experts,
+                expert_axis=self.expert_axis, ep_size=self.ep_size,
+                capacity_factor=self.capacity_factor)
         else:
             for i in range(self.num_layers):
                 x = LlamaBlock(self.num_heads, self.ffn_dim,

@@ -46,7 +46,11 @@ class MoEFFN(nn.Module):
     ep_size: int = 1               # expert-axis size (local = E / ep_size)
 
     @nn.compact
-    def __call__(self, x, *, train: bool = False):
+    def __call__(self, x, *, train: bool = False, aux_scale=1.0):
+        """``aux_scale`` multiplies the sown load-balance loss: the GPipe
+        schedule passes validity/(num_microbatches) so bubble steps sow
+        exactly zero and valid microbatch contributions average to the
+        full-batch scale (parallel/pp.py)."""
         b, t, h = x.shape
         e, ep = self.num_experts, self.ep_size
         if e % ep:
@@ -67,7 +71,8 @@ class MoEFFN(nn.Module):
         onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.float32)
         # Switch load-balance loss: E * sum_e f_e * P_e
         self.sow("aux", "load_balance",
-                 e * jnp.sum(onehot.mean(0) * probs.mean(0)))
+                 jnp.asarray(aux_scale, jnp.float32)
+                 * e * jnp.sum(onehot.mean(0) * probs.mean(0)))
         # position of each token within its expert's queue; drop overflow
         pos = jnp.einsum("ne,ne->n", jnp.cumsum(onehot, axis=0) - 1.0,
                          onehot).astype(jnp.int32)
@@ -112,6 +117,28 @@ def ep_param_specs(params, axis: str = "expert"):
     def spec(path, leaf):
         names = [getattr(p_, "key", str(p_)) for p_ in path]
         if "moe" in names and "gate" not in names:
+            return P(axis, *([None] * (leaf.ndim - 1)))
+        return P()
+    return jax.tree_util.tree_map_with_path(spec, params)
+
+
+def pp_ep_param_specs(params, *, pipe_axis: str = "pipe",
+                      axis: str = "expert"):
+    """PartitionSpec tree for a ``scan_layers`` MoE model under BOTH
+    pipeline and expert parallelism: leaves under the stacked ``layers``
+    collection shard their leading (layer) dim over ``pipe_axis``, and the
+    expert stacks (now at dim 1, behind the layer dim) additionally shard
+    over ``axis``; everything outside the stack replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    def spec(path, leaf):
+        names = [getattr(p_, "key", str(p_)) for p_ in path]
+        expert = "moe" in names and "gate" not in names
+        if "layers" in names:
+            if expert:
+                return P(pipe_axis, axis, *([None] * (leaf.ndim - 2)))
+            return P(pipe_axis, *([None] * (leaf.ndim - 1)))
+        if expert:
             return P(axis, *([None] * (leaf.ndim - 1)))
         return P()
     return jax.tree_util.tree_map_with_path(spec, params)

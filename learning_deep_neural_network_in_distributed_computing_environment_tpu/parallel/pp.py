@@ -115,11 +115,19 @@ def gpipe_apply_scanned(scanned, x: jnp.ndarray, axis_name: str,
     xs = x.reshape(m, b // m, *x.shape[1:])
 
     def sched_step(mod, carry, t):
-        return gpipe_step(lambda inp: mod(inp, None)[0], xs,
+        # MoE aux-loss scale for this schedule step: bubble steps (stage s
+        # has no microbatch at step t) contribute exactly zero, valid
+        # steps 1/m so the m per-microbatch losses average to full-batch
+        # scale.  The engine psums the summed aux over the pipe axis to
+        # restore the loss's pipe-invariance (train.py).
+        s = lax.axis_index(axis_name)
+        valid = ((t - s >= 0) & (t - s < m))
+        aux_scale = valid.astype(jnp.float32) / m
+        return gpipe_step(lambda inp: mod(inp, aux_scale)[0], xs,
                           axis_name, m, carry, t), None
 
     sched = nn.scan(sched_step, variable_broadcast="params",
-                    split_rngs={"params": False})
+                    variable_axes={"aux": 0}, split_rngs={"params": False})
     steps = jnp.arange(m + pp_size - 1)
     (_, outs), _ = sched(scanned, gpipe_carry0(xs, axis_name), steps)
     return gpipe_finalize(outs, axis_name).reshape(x.shape)

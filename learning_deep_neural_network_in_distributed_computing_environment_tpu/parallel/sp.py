@@ -42,13 +42,27 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """
     n = lax.axis_size(axis_name)
     b, lc, h, d = q.shape
+    # K/V may carry fewer heads (grouped-query attention): scores/outputs
+    # use grouped einsums, and — the point of GQA here — the K/V blocks
+    # that rotate around the ring are ``rep``x smaller, cutting the ICI
+    # traffic per rotation by the group factor.
+    from ..ops.attention import kv_group_size
+    rep = kv_group_size(q, k)
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     qf = q.astype(jnp.float32)
+    if rep > 1:
+        qf = qf.reshape(b, lc, h // rep, rep, d)
     idx = lax.axis_index(axis_name)
 
     def block(kb, vb, t):
         """Scores of local queries against one K/V block (fp32)."""
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kb.astype(jnp.float32)) * scale
+        if rep == 1:
+            s = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                           kb.astype(jnp.float32)) * scale
+        else:
+            s = jnp.einsum("bqgrd,bkgd->bgrqk", qf,
+                           kb.astype(jnp.float32)) * scale
+            s = s.reshape(b, h, lc, kb.shape[1])
         if causal:
             src = (idx - t) % n                     # chunk's home device
             cm = causal_mask(lc, lc, q_offset=idx * lc, k_offset=src * lc)
@@ -74,8 +88,14 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         corr = jnp.exp(m - m_new)
         p = jnp.exp(s - m_new[..., None])
         l = l * corr + p.sum(axis=-1)
-        o = o * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, vb_.astype(jnp.float32))
+        if rep == 1:
+            pv = jnp.einsum("bhqk,bkhd->bhqd", p, vb_.astype(jnp.float32))
+        else:
+            lk = vb_.shape[1]
+            pv = jnp.einsum("bgrqk,bkgd->bgrqd",
+                            p.reshape(b, h // rep, rep, lc, lk),
+                            vb_.astype(jnp.float32)).reshape(b, h, lc, d)
+        o = o * corr[..., None] + pv
         # rotate K/V to the next ring position
         perm = [(i, (i + 1) % n) for i in range(n)]
         kb = lax.ppermute(kb, axis_name, perm)
@@ -101,10 +121,12 @@ def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """
     n = lax.axis_size(axis_name)
     b, lc, h, d = q.shape
-    if h % n:
+    kv = k.shape[2]
+    if h % n or kv % n:
         raise ValueError(
-            f"ulysses attention needs heads ({h}) divisible by the seq-axis "
-            f"size ({n}); use ring attention otherwise")
+            f"ulysses attention needs query heads ({h}) and kv heads ({kv}) "
+            f"divisible by the seq-axis size ({n}); use ring attention "
+            "otherwise")
     from ..ops.attention import dot_product_attention
 
     def to_heads(x):   # [B, Lc, H, D] -> [B, L, H/n, D]

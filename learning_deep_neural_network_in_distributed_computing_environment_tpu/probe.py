@@ -73,9 +73,15 @@ def gather_durations(local_duration: float, world_size: int,
         per_process = np.asarray(gathered).ravel()
         # jax.devices() orders devices contiguously by process (process 0's
         # local devices first), so each process's timing covers a contiguous
-        # block of mesh positions
-        reps = int(np.ceil(world_size / per_process.size))
-        return np.repeat(per_process, reps)[:world_size]
+        # block of mesh positions.  Same strictness as the wall-time twin
+        # (driver._measured_worker_walls): a non-divisible worker/process
+        # count would silently mis-attribute durations, so refuse it.
+        if world_size % per_process.size:
+            raise ValueError(
+                f"worker axis ({world_size}) not evenly divided by process "
+                f"count ({per_process.size}); per-process probe-duration "
+                "attribution would be wrong")
+        return np.repeat(per_process, world_size // per_process.size)
     return np.full(world_size, local_duration, np.float64)
 
 

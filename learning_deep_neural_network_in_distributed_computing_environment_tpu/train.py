@@ -182,6 +182,11 @@ class LocalSGDEngine:
         # over 'fsdp', batch split over it, params all-gathered per step
         self.fsdp_axis = (
             FSDP_AXIS if int(mesh.shape.get(FSDP_AXIS, 1)) > 1 else None)
+        # pipeline parallelism: the MoE aux loss is stage-partial and gets
+        # psum'd over 'pipe' to keep the loss pipe-invariant
+        from .mesh import PIPE_AXIS
+        self.pipe_axis = (
+            PIPE_AXIS if int(mesh.shape.get(PIPE_AXIS, 1)) > 1 else None)
         # tensor parallelism: params(single-replica) -> PartitionSpec tree
         # over the 'model' axis (e.g. models.bert.tp_param_specs)
         self.param_specs_fn = param_specs_fn
@@ -381,10 +386,19 @@ class LocalSGDEngine:
         else:
             loss = _masked_mean(ce, w)
             total = w.sum()
-        # MoE load-balance auxiliary losses sown by models/moe.py
+        # MoE load-balance auxiliary losses sown by models/moe.py.  Leaves
+        # may be stacked: [n_local] under scan_layers, [steps, n_local]
+        # under the GPipe schedule (bubble steps sown as exact zeros and
+        # valid steps pre-scaled by 1/M — parallel/pp.py), so each leaf is
+        # summed fully.  Under pipeline parallelism the sum is per-stage
+        # partial; psum over 'pipe' restores the pipe-invariant loss the
+        # replicated-gradient construction relies on.
         aux = jax.tree_util.tree_leaves(mut.get("aux", {}))
         if aux:
-            loss = loss + self.cfg.moe_aux_weight * sum(aux)
+            a = sum(jnp.sum(x) for x in aux)
+            if self.pipe_axis is not None:
+                a = lax.psum(a, self.pipe_axis)
+            loss = loss + self.cfg.moe_aux_weight * a
         new_bs = mut.get("batch_stats", batch_stats)
         if self.fsdp_axis and jax.tree_util.tree_leaves(new_bs):
             # BatchNorm under FSDP: each device normalized its sub-batch

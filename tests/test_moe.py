@@ -125,3 +125,94 @@ class TestDriverExpertParallel:
                      augment=False)
         with pytest.raises(ValueError, match="expert"):
             train_global(cfg, mesh=mesh, progress=False)
+
+
+class TestMoEScanAndPipeline:
+    """MoE x scan_layers (the sown aux lifts through ``nn.scan`` stacked)
+    and MoE x pipeline parallelism (bubble-masked aux through the GPipe
+    schedule, round-2 verdict item 7)."""
+
+    def test_scanned_forward_matches_unrolled(self):
+        """Same per-layer MoE params => identical logits for the two
+        layouts (pattern of test_pp.TestScannedBert)."""
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import get_model
+        loop = get_model("bert_tiny", num_classes=97, num_experts=4)
+        scan = get_model("bert_tiny", num_classes=97, num_experts=4,
+                         scan_layers=True)
+        x = jnp.asarray(
+            np.random.default_rng(0).integers(0, 97, (2, 16)), jnp.int32)
+        pl_ = loop.init(jax.random.key(1), x, train=False)["params"]
+        ps = {k: v for k, v in pl_.items() if not k.startswith("layer")}
+        ps["layers"] = {"layer": jax.tree.map(
+            lambda *ls: jnp.stack(ls), pl_["layer0"], pl_["layer1"])}
+        np.testing.assert_allclose(
+            scan.apply({"params": ps}, x, train=False),
+            loop.apply({"params": pl_}, x, train=False), atol=1e-5)
+
+    def test_scanned_aux_is_stacked_and_sums_match(self):
+        """The scanned model's sown aux carries a leading layer axis and
+        its total equals the unrolled model's per-layer scalar sum."""
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import get_model
+        loop = get_model("bert_tiny", num_classes=97, num_experts=4)
+        scan = get_model("bert_tiny", num_classes=97, num_experts=4,
+                         scan_layers=True)
+        x = jnp.asarray(
+            np.random.default_rng(1).integers(0, 97, (2, 16)), jnp.int32)
+        pl_ = loop.init(jax.random.key(2), x, train=False)["params"]
+        ps = {k: v for k, v in pl_.items() if not k.startswith("layer")}
+        ps["layers"] = {"layer": jax.tree.map(
+            lambda *ls: jnp.stack(ls), pl_["layer0"], pl_["layer1"])}
+        _, mut_s = scan.apply({"params": ps}, x, train=True,
+                              mutable=["aux"])
+        _, mut_l = loop.apply({"params": pl_}, x, train=True,
+                              mutable=["aux"])
+        leaves_s = jax.tree_util.tree_leaves(mut_s["aux"])
+        assert any(l.ndim >= 1 and l.shape[0] == 2 for l in leaves_s)
+        tot_s = sum(float(jnp.sum(l)) for l in leaves_s)
+        tot_l = sum(float(jnp.sum(l))
+                    for l in jax.tree_util.tree_leaves(mut_l["aux"]))
+        np.testing.assert_allclose(tot_s, tot_l, rtol=1e-5)
+
+    def _run(self, devices, mesh_axes, **kw):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.mesh import build_mesh
+        mesh = build_mesh(mesh_axes, devices)
+        # generous capacity so no token drops either way: per-microbatch
+        # routing then dispatches identically to full-batch routing and
+        # only the aux-loss batching differs (microbatch mean vs full-
+        # batch value), kept out of the trajectory with aux weight 0
+        cfg = Config(model="bert_tiny", dataset="synthetic_mlm",
+                     epochs_global=2, epochs_local=1, batch_size=8,
+                     limit_train_samples=128, limit_eval_samples=32,
+                     compute_dtype="float32", augment=False,
+                     aggregation_by="weights", seed=7, num_experts=4,
+                     expert_capacity_factor=2.0, moe_aux_weight=0.0, **kw)
+        return train_global(cfg, mesh=mesh, progress=False)
+
+    def test_driver_moe_pp_matches_unsharded(self, devices):
+        base = self._run(devices[:2], {"data": 2})
+        pp = self._run(devices[:4], {"data": 2, "pipe": 2})
+        np.testing.assert_allclose(pp["global_train_losses"],
+                                   base["global_train_losses"], rtol=2e-3)
+        assert pp["global_train_losses"][-1] < pp["global_train_losses"][0]
+
+    def test_driver_moe_pp_ep_trains(self, devices):
+        """3-D: (data=2, pipe=2, expert=2) — stacked layer axis over
+        'pipe', expert stacks over 'expert' (pp_ep_param_specs), with the
+        default aux weight active."""
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.mesh import build_mesh
+        mesh = build_mesh({"data": 2, "pipe": 2, "expert": 2}, devices[:8])
+        cfg = Config(model="bert_tiny", dataset="synthetic_mlm",
+                     epochs_global=2, epochs_local=1, batch_size=8,
+                     limit_train_samples=128, limit_eval_samples=32,
+                     compute_dtype="float32", augment=False,
+                     aggregation_by="weights", seed=7, num_experts=4)
+        res = train_global(cfg, mesh=mesh, progress=False)
+        assert np.isfinite(res["global_train_losses"]).all()
+        assert res["global_train_losses"][-1] < res["global_train_losses"][0]
+        specs = [str(l.sharding.spec) for l in
+                 jax.tree_util.tree_leaves(res["state"].params)]
+        assert any("pipe" in s and "expert" in s for s in specs)
