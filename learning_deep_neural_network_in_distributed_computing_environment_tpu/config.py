@@ -79,9 +79,13 @@ class Config:
     # --- multi-axis mesh (beyond-reference parallelism) --------------------
     mesh_shape: str = "data=-1"   # e.g. "data=8", "data=4,model=2",
     #                               "data=2,model=2,pipe=2"
-    sequence_parallel: str = "none"  # none | ring | all_to_all (for bert)
+    sequence_parallel: str = "none"  # none | ring | ring_zigzag (causal
+    #                                  models only) | all_to_all
     attention_impl: str = "dense"    # dense | flash (Pallas kernel; bert)
     pp_microbatches: int = 0         # GPipe microbatches (0 => pipe size)
+    pp_remat: bool = False           # rematerialize each layer under PP
+    #                                  (GPipe-paper memory recipe: save
+    #                                  only layer-boundary activations)
     num_experts: int = 0             # >0 => MoE FFN in bert/gpt layers
     num_kv_heads: int = 0            # >0 => GQA (llama_* models)
     expert_capacity_factor: float = 1.25
@@ -192,13 +196,18 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--no_augment", action="store_true")
     p.add_argument("--mesh_shape", type=str, default=d.mesh_shape)
     p.add_argument("--sequence_parallel", type=str, default=d.sequence_parallel,
-                   choices=["none", "ring", "all_to_all"])
+                   choices=["none", "ring", "ring_zigzag", "all_to_all"])
     p.add_argument("--attention_impl", type=str, default=d.attention_impl,
                    choices=["dense", "flash"],
                    help="attention kernel for bert models (flash = Pallas)")
     p.add_argument("--pp_microbatches", type=int, default=d.pp_microbatches,
                    help="GPipe microbatches when the mesh has a pipe axis "
                         "(0 = pipe size)")
+    p.add_argument("--pp_remat", action="store_true",
+                   default=d.pp_remat,
+                   help="rematerialize each layer under pipeline "
+                        "parallelism (save only layer-boundary "
+                        "activations; ~1/3 extra forward compute)")
     p.add_argument("--num_kv_heads", type=int, default=d.num_kv_heads,
                    help="grouped-query attention kv-head count "
                         "(llama_* models; 0 = multi-head)")
@@ -222,6 +231,11 @@ def config_from_args(argv: list[str] | None = None) -> Config:
         # out-of-tree plugin may have pinned the platform via jax.config at
         # interpreter start (env var alone would be ignored), so set both
         os.environ["JAX_PLATFORMS"] = args.device
+        if args.device == "cpu":
+            # CPU thunk executor collective-deadlock workaround (see
+            # xla_flags.py); only effective before backend init
+            from .xla_flags import ensure_sequential_cpu_collectives
+            ensure_sequential_cpu_collectives()
         import jax
         jax.config.update("jax_platforms", args.device)
     field_names = {f.name for f in dataclasses.fields(Config)}

@@ -135,16 +135,27 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                 f"a '{PIPE_AXIS}' mesh axis (pipeline parallelism) applies "
                 f"to attention models (bert_*/gpt_*/vit_*/llama_*); got --model {cfg.model}")
         if cfg.sequence_parallel != "none":
-            raise NotImplementedError(
-                "pipeline parallelism does not yet compose with "
-                "--sequence_parallel (the ring rotation inside the GPipe "
-                "schedule reaches a mismatched collective schedule; "
-                "verified to abort rather than run)")
+            # SP x PP is supported, but on an UNPINNED CPU backend the
+            # concurrency-optimized thunk executor can deadlock on the
+            # seq-pair psums racing the pipe ppermutes — fail fast with
+            # instructions instead of a 40 s hang + SIGABRT
+            from .xla_flags import (SEQUENTIAL_CPU_COLLECTIVES_FLAG,
+                                    sequential_cpu_collectives_pinned)
+            if (jax.default_backend() == "cpu"
+                    and not sequential_cpu_collectives_pinned()):
+                raise RuntimeError(
+                    "sequence parallelism x pipeline parallelism on the "
+                    "CPU backend needs the sequential collective "
+                    "scheduler pinned BEFORE jax initializes: set "
+                    f"XLA_FLAGS={SEQUENTIAL_CPU_COLLECTIVES_FLAG} (the "
+                    "CLI --device cpu, tests/conftest.py, and "
+                    "__graft_entry__.py do this automatically)")
         from functools import partial
         from .parallel.pp import pp_param_specs
         base_kw.update(scan_layers=True)
         train_kw.update(pipeline_axis=PIPE_AXIS, pp_size=pp,
-                        num_microbatches=cfg.pp_microbatches)
+                        num_microbatches=cfg.pp_microbatches,
+                        remat=cfg.pp_remat)
         param_specs_fn = partial(pp_param_specs, axis=PIPE_AXIS)
     if cfg.num_kv_heads > 0:
         # grouped-query attention (models/llama.py; the Llama-2/3 recipe)
@@ -258,6 +269,13 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             raise ValueError(
                 "--sequence_parallel applies to token-sequence models "
                 f"(bert_*/gpt_*/llama_*); got --model {cfg.model}")
+        if (cfg.sequence_parallel == "ring_zigzag"
+                and not cfg.model.startswith(("gpt", "llama"))):
+            raise ValueError(
+                "--sequence_parallel ring_zigzag balances CAUSAL masking "
+                "work and applies to causal models (gpt_*/llama_*); "
+                f"got --model {cfg.model} — use 'ring' for bidirectional "
+                "attention")
         # the round program runs ring / all-to-all attention over the seq
         # axis; init/probe/final-eval keep the dense twin (same params)
         train_kw.update(attention_impl=cfg.sequence_parallel,

@@ -198,7 +198,7 @@ class _ScanLayer(nn.Module):
 
 def apply_scanned_stack(scan_layer_cls, x, *, num_layers: int, pp_size: int,
                         pipeline_axis, num_microbatches: int, train: bool,
-                        **layer_kw):
+                        remat: bool = False, **layer_kw):
     """``nn.scan`` the stacked ``layers`` collection and run it plain or as
     a GPipe schedule — shared by BERT/GPT/ViT/Llama.  The stacked
     collection's leading [num_layers] axis is what ``pp_param_specs``
@@ -213,8 +213,16 @@ def apply_scanned_stack(scan_layer_cls, x, *, num_layers: int, pp_size: int,
         raise ValueError(f"num_layers {num_layers} not divisible "
                          f"by pp_size {pp_size}")
     n_local = num_layers // pp_size
+    cls = scan_layer_cls
+    if remat:
+        # rematerialize each layer on the backward pass: only the layer-
+        # boundary activations are saved (the GPipe paper's own memory
+        # recipe), cutting the all-activations-live profile of autodiff-
+        # through-the-schedule by ~the per-layer intermediate count at
+        # ~1/3 extra forward compute
+        cls = nn.remat(scan_layer_cls, prevent_cse=False)
     scanned = nn.scan(
-        scan_layer_cls, variable_axes={"params": 0, "aux": 0},
+        cls, variable_axes={"params": 0, "aux": 0},
         split_rngs={"params": True}, in_axes=nn.broadcast,
         length=n_local)(
             train=train, name="layers", **layer_kw)
@@ -253,6 +261,7 @@ class BertForMLM(nn.Module):
     pp_size: int = 1               # pipe-axis size (static; local layer
     #                                count = num_layers // pp_size)
     num_microbatches: int = 0      # 0 => pp_size
+    remat: bool = False            # rematerialize each layer (memory)
     num_experts: int = 0           # >0 => MoE FFN in every layer
     expert_axis: Optional[str] = None
     ep_size: int = 1
@@ -317,7 +326,7 @@ class BertForMLM(nn.Module):
     def _encode_scanned(self, x, train: bool):
         return apply_scanned_stack(
             _ScanLayer, x, num_layers=self.num_layers, pp_size=self.pp_size,
-            pipeline_axis=self.pipeline_axis,
+            pipeline_axis=self.pipeline_axis, remat=self.remat,
             num_microbatches=self.num_microbatches, train=train,
             num_heads=self.num_heads, ffn_dim=self.ffn_dim,
             dtype=self.dtype, attention_impl=self.attention_impl,

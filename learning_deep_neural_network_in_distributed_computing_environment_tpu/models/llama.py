@@ -152,6 +152,7 @@ class LlamaForCausalLM(nn.Module):
     pipeline_axis: Optional[str] = None
     pp_size: int = 1
     num_microbatches: int = 0      # 0 => pp_size
+    remat: bool = False            # rematerialize each layer (memory)
     num_experts: int = 0           # >0 => Switch-MoE FFN in every block
     expert_axis: Optional[str] = None
     ep_size: int = 1
@@ -175,6 +176,7 @@ class LlamaForCausalLM(nn.Module):
             x = apply_scanned_stack(
                 _ScanLlamaBlock, x, num_layers=self.num_layers,
                 pp_size=self.pp_size, pipeline_axis=self.pipeline_axis,
+                remat=self.remat,
                 num_microbatches=self.num_microbatches, train=train,
                 num_heads=self.num_heads, ffn_dim=self.ffn_dim,
                 dtype=self.dtype, attention_impl=self.attention_impl,

@@ -113,7 +113,7 @@ def attend(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     if impl == "flash":
         from .pallas_ops import flash_attention
         return flash_attention(q, k, v, mask, causal=causal)
-    if impl in ("ring", "all_to_all"):
+    if impl in ("ring", "ring_zigzag", "all_to_all"):
         if axis_name is None:
             raise ValueError(f"{impl} attention requires axis_name (the mesh "
                              "axis the sequence is sharded over)")
@@ -121,6 +121,14 @@ def attend(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
             raise NotImplementedError(
                 f"{impl} attention supports full bidirectional or causal "
                 "attention (mask=None); arbitrary masks are not sharded")
+        if impl == "ring_zigzag":
+            if not causal:
+                raise ValueError(
+                    "ring_zigzag exists to balance CAUSAL masking work; "
+                    "bidirectional attention has no dead blocks — use "
+                    "impl='ring'")
+            from ..parallel.sp import ring_attention_zigzag
+            return ring_attention_zigzag(q, k, v, axis_name)
         if impl == "ring":
             from ..parallel.sp import ring_attention
             return ring_attention(q, k, v, axis_name, causal=causal)

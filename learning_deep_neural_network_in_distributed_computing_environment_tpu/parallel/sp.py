@@ -49,19 +49,20 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     from ..ops.attention import kv_group_size
     rep = kv_group_size(q, k)
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
-    qf = q.astype(jnp.float32)
-    if rep > 1:
-        qf = qf.reshape(b, lc, h // rep, rep, d)
+    # matmul inputs stay in the model dtype (bf16 on TPU: full MXU rate)
+    # with f32 accumulation via preferred_element_type; only the softmax
+    # state is f32
+    qf = q if rep == 1 else q.reshape(b, lc, h // rep, rep, d)
     idx = lax.axis_index(axis_name)
 
     def block(kb, vb, t):
         """Scores of local queries against one K/V block (fp32)."""
         if rep == 1:
-            s = jnp.einsum("bqhd,bkhd->bhqk", qf,
-                           kb.astype(jnp.float32)) * scale
+            s = jnp.einsum("bqhd,bkhd->bhqk", qf, kb,
+                           preferred_element_type=jnp.float32) * scale
         else:
-            s = jnp.einsum("bqgrd,bkgd->bgrqk", qf,
-                           kb.astype(jnp.float32)) * scale
+            s = jnp.einsum("bqgrd,bkgd->bgrqk", qf, kb,
+                           preferred_element_type=jnp.float32) * scale
             s = s.reshape(b, h, lc, kb.shape[1])
         if causal:
             src = (idx - t) % n                     # chunk's home device
@@ -89,12 +90,16 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         p = jnp.exp(s - m_new[..., None])
         l = l * corr + p.sum(axis=-1)
         if rep == 1:
-            pv = jnp.einsum("bhqk,bkhd->bhqd", p, vb_.astype(jnp.float32))
+            pv = jnp.einsum("bhqk,bkhd->bhqd", p.astype(vb_.dtype), vb_,
+                            preferred_element_type=jnp.float32)
         else:
             lk = vb_.shape[1]
             pv = jnp.einsum("bgrqk,bkgd->bgrqd",
-                            p.reshape(b, h // rep, rep, lc, lk),
-                            vb_.astype(jnp.float32)).reshape(b, h, lc, d)
+                            p.astype(vb_.dtype).reshape(
+                                b, h // rep, rep, lc, lk),
+                            vb_,
+                            preferred_element_type=jnp.float32
+                            ).reshape(b, h, lc, d)
         o = o * corr[..., None] + pv
         # rotate K/V to the next ring position
         perm = [(i, (i + 1) % n) for i in range(n)]
@@ -105,6 +110,141 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     (kb, vb, o, m, l), _ = lax.scan(body, (k, v, o, m, l), jnp.arange(n))
     out = (o / l[..., None]).astype(q.dtype)         # [B, H, Lc, D]
     return jnp.transpose(out, (0, 2, 1, 3))          # -> [B, Lc, H, D]
+
+
+def ring_attention_zigzag(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                          axis_name: str) -> jnp.ndarray:
+    """Causal ring attention with ZIG-ZAG half-chunk balancing.
+
+    Plain causal ring computes every rotation's full [Lc, Lc] score block
+    and masks it: at rotation t the t devices holding fully-future K/V do
+    pure throwaway work, so HALF of all block matmuls are wasted and the
+    per-step critical path is set by the busiest device.  Zig-zag
+    (the Llama-3 / ring-flash-attention assignment) splits the sequence
+    into 2n half-chunks and gives device i halves (i, 2n-1-i); then at
+    EVERY rotation EVERY device has exactly 2 of its 4 (q-half, kv-half)
+    sub-blocks causally live (1 full + 2 diagonal at t=0) — balanced, and
+    the dead sub-blocks are skipped with ``lax.cond`` so their matmuls
+    never execute: ~2x less attention compute at the same exactness.
+
+    Inputs/outputs are in the engine's CONTIGUOUS layout (device i holds
+    ``[i*Lc, (i+1)*Lc)``, RoPE already applied with global positions);
+    the zig-zag redistribution and its inverse are internal ppermutes.
+    Requires an even per-device chunk length.
+    """
+    n = lax.axis_size(axis_name)
+    b, lc, h, d = q.shape
+    if lc % 2:
+        raise ValueError(f"zig-zag ring needs an even per-device chunk "
+                         f"length, got {lc}")
+    from ..ops.attention import kv_group_size
+    rep = kv_group_size(q, k)
+    half = lc // 2
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    idx = lax.axis_index(axis_name)
+
+    def t_of(hh):  # home device of global half-chunk hh under zig-zag
+        return hh if hh < n else 2 * n - 1 - hh
+
+    perm1 = [(j, t_of(2 * j)) for j in range(n)]        # even global halves
+    perm2 = [(j, t_of(2 * j + 1)) for j in range(n)]    # odd global halves
+    inv1 = [(t_of(2 * j), j) for j in range(n)]
+    inv2 = [(t_of(2 * j + 1), j) for j in range(n)]
+    even = (idx % 2 == 0)
+
+    def to_zigzag(x):
+        """[B, Lc, ...] contiguous -> (slotA, slotB) with global half ids
+        (idx, 2n-1-idx)."""
+        r1 = lax.ppermute(x[:, :half], axis_name, perm1)
+        r2 = lax.ppermute(x[:, half:], axis_name, perm2)
+        a = jnp.where(even, r1, r2)
+        bslot = jnp.where(even, r2, r1)
+        return a, bslot
+
+    def from_zigzag(a, bslot):
+        """(slotA, slotB) -> [B, Lc, ...] contiguous."""
+        evn = jnp.where(even, a, bslot)   # this device's even global half
+        odd = jnp.where(even, bslot, a)
+        first = lax.ppermute(evn, axis_name, inv1)
+        second = lax.ppermute(odd, axis_name, inv2)
+        return jnp.concatenate([first, second], axis=1)
+
+    qa, qb = to_zigzag(q)
+    ka, kb_ = to_zigzag(k)
+    va, vb_ = to_zigzag(v)
+    if rep > 1:
+        qa = qa.reshape(b, half, h // rep, rep, d)
+        qb = qb.reshape(b, half, h // rep, rep, d)
+
+    def update(qh, kh, vh, m, l, acc, gq, gk):
+        """Online-softmax update of one (q-half, kv-half) sub-block with
+        causal masking by global half ids; matmuls stay in model dtype."""
+        if rep == 1:
+            s = jnp.einsum("bqhd,bkhd->bhqk", qh, kh,
+                           preferred_element_type=jnp.float32) * scale
+        else:
+            s = jnp.einsum("bqgrd,bkgd->bgrqk", qh, kh,
+                           preferred_element_type=jnp.float32) * scale
+            s = s.reshape(b, h, half, half)
+        qpos = gq * half + jax.lax.broadcasted_iota(jnp.int32,
+                                                    (half, half), 0)
+        kpos = gk * half + jax.lax.broadcasted_iota(jnp.int32,
+                                                    (half, half), 1)
+        s = jnp.where((kpos <= qpos)[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l = l * corr + p.sum(axis=-1)
+        if rep == 1:
+            pv = jnp.einsum("bhqk,bkhd->bhqd", p.astype(vh.dtype), vh,
+                            preferred_element_type=jnp.float32)
+        else:
+            pv = jnp.einsum("bgrqk,bkgd->bgrqd",
+                            p.astype(vh.dtype).reshape(
+                                b, h // rep, rep, half, half),
+                            vh, preferred_element_type=jnp.float32
+                            ).reshape(b, h, half, d)
+        return m_new, l, acc * corr[..., None] + pv
+
+    def maybe(qh, kh, vh, state, gq, gk):
+        """Run ``update`` only when the sub-block is causally live —
+        ``lax.cond`` with a device-varying predicate skips the dead
+        matmuls entirely (both branches are collective-free)."""
+        return lax.cond(
+            gk <= gq,
+            lambda s: update(qh, kh, vh, *s, gq, gk),
+            lambda s: s,
+            state)
+
+    vma = tuple(sorted(set(getattr(jax.typeof(q), "vma", ()))
+                       | {axis_name}))
+    vary = lambda x: lax.pcast(x, vma, to="varying")
+    zero_state = lambda: (
+        vary(jnp.full((b, h, half), -jnp.inf, jnp.float32)),
+        vary(jnp.zeros((b, h, half), jnp.float32)),
+        vary(jnp.zeros((b, h, half, d), jnp.float32)))
+    ga, gb = idx, 2 * n - 1 - idx
+
+    def body(carry, t):
+        ka, kb_, va, vb_, sA, sB = carry
+        src = (idx - t) % n
+        gka, gkb = src, 2 * n - 1 - src
+        for kh, vh, gk in ((ka, va, gka), (kb_, vb_, gkb)):
+            sA = maybe(qa, kh, vh, sA, ga, gk)
+            sB = maybe(qb, kh, vh, sB, gb, gk)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        ka, kb_, va, vb_ = (lax.ppermute(x, axis_name, perm)
+                            for x in (ka, kb_, va, vb_))
+        return (ka, kb_, va, vb_, sA, sB), None
+
+    (ka, kb_, va, vb_, (mA, lA, accA), (mB, lB, accB)), _ = lax.scan(
+        body, (ka, kb_, va, vb_, zero_state(), zero_state()),
+        jnp.arange(n))
+    outA = jnp.transpose((accA / lA[..., None]).astype(q.dtype),
+                         (0, 2, 1, 3))                  # [B, half, H, D]
+    outB = jnp.transpose((accB / lB[..., None]).astype(q.dtype),
+                         (0, 2, 1, 3))
+    return from_zigzag(outA, outB)
 
 
 def ulysses_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,

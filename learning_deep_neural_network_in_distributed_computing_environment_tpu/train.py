@@ -372,6 +372,17 @@ class LocalSGDEngine:
         ce, w, correct = self._token_stats(out, yb, mb)
         part_axes = self._part_axes()
         if part_axes:
+            # ORDER the mask-only psums below after the model's own
+            # collectives: ``w`` derives from the batch mask alone, so its
+            # psums are otherwise DAG-independent of the forward pass and
+            # the XLA:CPU thunk executor may start them concurrently with
+            # the model's ppermutes on different devices — intersecting-
+            # group collectives entered in different per-device orders
+            # deadlock the CPU collective rendezvous (reproduced by
+            # SP x PP stress runs; 40 s timeout then SIGABRT).  Routing
+            # ``w`` through a barrier with ``ce`` (which depends on the
+            # model output) serializes them; free on TPU.
+            w = lax.optimization_barrier((w, ce))[0]
             # the batch is partial on this device: under seq parallelism it
             # holds one chunk of every sequence, under FSDP a slice of the
             # worker's batch (composable — psum over both).  The loss is

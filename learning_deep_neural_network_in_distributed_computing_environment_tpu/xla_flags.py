@@ -1,0 +1,41 @@
+"""Process-level XLA flag setup that must run BEFORE jax initializes a
+backend.  Import-light on purpose (os only): callers import this before
+any jax import can win the race.
+"""
+
+from __future__ import annotations
+
+import os
+
+SEQUENTIAL_CPU_COLLECTIVES_FLAG = (
+    "--xla_cpu_enable_concurrency_optimized_scheduler=false")
+
+
+def ensure_sequential_cpu_collectives() -> bool:
+    """Pin the sequential CPU thunk scheduler via XLA_FLAGS.
+
+    The concurrency-optimized XLA:CPU thunk executor may enter
+    DAG-independent collectives in a nondeterministic per-device order;
+    with intersecting device groups (e.g. a seq-pair psum racing a pipe
+    ppermute under SP x PP) two virtual devices can join different
+    rendezvous and deadlock — 40 s timeout, then SIGABRT.  The sequential
+    scheduler gives every virtual device the same collective order.
+    Real-TPU runs are unaffected (collectives execute in stream order).
+
+    Returns True when the flag is (now) present.  Only effective if the
+    CPU backend has not been initialized yet — callers run this at import
+    time, before jax.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_cpu_enable_concurrency_optimized_scheduler" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " " + SEQUENTIAL_CPU_COLLECTIVES_FLAG).strip()
+    return True
+
+
+def sequential_cpu_collectives_pinned() -> bool:
+    """Whether XLA_FLAGS carries a setting for the scheduler (either
+    value) — used by the driver to fail fast instead of deadlocking when
+    a hazardous composition is requested on an unpinned CPU backend."""
+    return ("xla_cpu_enable_concurrency_optimized_scheduler"
+            in os.environ.get("XLA_FLAGS", ""))
