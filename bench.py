@@ -29,11 +29,13 @@ numerator ("bytes accessed") is still XLA's post-fusion cost-model
 *estimate* of HBM traffic, which can overcount — fracs > 1.0 are clamped
 and the raw value kept under ``hbm_roofline_frac_raw``.
 
-Methodology (see memory: chain K steps + one fetch): each sample chains K
-data-dependent steps and fetches once — block_until_ready alone lies on
-remote-relay PJRT backends.  3 chains, median; if they disagree by > 30%
-(transient relay slow windows), 4 more chains are sampled and the median
-is taken over all 7.
+Methodology: each timed sample is ONE dispatch of a K-step in-executable
+``lax.scan`` plus one scalar fetch, with the measured fetch round-trip
+(~85-120 ms on this relay) subtracted — block_until_ready alone lies on
+remote-relay PJRT backends, and Python-loop chains of small steps measure
+the 7-17 ms per-dispatch link overhead, not the chip.  3 samples, median;
+if they disagree by > 30% (transient relay slow windows), 4 more are
+sampled and the median is taken over all 7.
 """
 
 from __future__ import annotations
@@ -445,15 +447,18 @@ LADDER = [
     # land FIRST — a mid-sweep cutoff still leaves the headline captured.
     # max_scan_k caps the in-executable scan length (_pick_k targets
     # ~0.35 s of device time per timed sample).
-    ("resnet50_imagenet", "resnet50", (224, 224, 3), 128, 60, 1000, False, 300),
-    ("bert_base_mlm_l128", "bert_base", (128,), 64, 60, 30522, True, 300),
+    # timeouts carry slack for a contended host: compiles pay host-side
+    # tracing, and the watchdog killing the HEADLINE entry loses the
+    # round's value even though later entries land
+    ("resnet50_imagenet", "resnet50", (224, 224, 3), 128, 60, 1000, False, 540),
+    ("bert_base_mlm_l128", "bert_base", (128,), 64, 60, 30522, True, 420),
     ("enhanced_cnn_cifar10", "enhanced_cnn", (32, 32, 3), 256, 200, 10, False, 180),
     ("resnet18_cifar10", "resnet18", (32, 32, 3), 256, 200, 10, False, 180),
     ("mlp_mnist", "mlp", (28, 28, 1), 256, 400, 10, False, 120),
     ("lenet5_mnist", "lenet5", (28, 28, 1), 256, 400, 10, False, 120),
     ("gpt2_small_lm_l512", "gpt2_small", (512,), 16, 60, 50257, True, 300),
-    ("vit_s16_imagenet", "vit_s16", (224, 224, 3), 128, 60, 1000, False, 300),
-    ("vit_b16_imagenet", "vit_b16", (224, 224, 3), 128, 30, 1000, False, 360),
+    ("vit_s16_imagenet", "vit_s16", (224, 224, 3), 128, 60, 1000, False, 420),
+    ("vit_b16_imagenet", "vit_b16", (224, 224, 3), 128, 30, 1000, False, 480),
     # long-context capability row: Pallas flash attention end-to-end in a
     # training step (dense XLA attention at this L is O(L^2)-HBM-bound)
     ("gpt2_small_lm_l4096_flash", "gpt2_small", (4096,), 2, 30, 50257, True,
@@ -522,6 +527,33 @@ def main() -> None:
     fast = os.environ.get("BENCH_FAST") == "1"
     details = {}
     notes = {
+        "headroom_r3": {
+            "gpt2_l4096_flash": "~30% MFU is a calibrated workload "
+                "ceiling, not an unexploited lever: measured levers — "
+                "batch 2->4->8 (29.7/29.3/31.5%), flash block retune "
+                "(BQ,BK sweep: (512,1024) default best; larger blocks "
+                "fail VMEM compile) — are dead ends.  Decomposition: "
+                "12x flash fwd+bwd = 29 ms of the ~105 ms step (flash "
+                "fwd runs 52 TF/s at B=2's small grid), the rest is "
+                "matmuls + the 50k-vocab cross-entropy's f32 softmax "
+                "HBM traffic.",
+            "vit_s16": "~27% MFU is byte-bound at the MEASURED "
+                "bandwidth (step traffic/time ~= streaming rate); "
+                "levers measured dead: B=256 (24.3%), scan_layers "
+                "(67->89 ms), scan+remat (95 ms).",
+            "llama_medium": "39.4% at B=8 sits near the measured byte "
+                "bound (roofline 0.91); B=16 flat (39.2%).  GQA is the "
+                "productive lever: num_kv_heads=4 lifts flash to 43.5% "
+                "MFU / +24% throughput (52.7->65.2 seq/s) by cutting "
+                "K/V traffic — the grouped-KV path, not a repeat "
+                "expansion, end to end.",
+            "resnet50_bn_kernel": "fused BN-train Pallas kernel KILLED "
+                "by measurement: XLA's compiled bn+relu fwd+bwd already "
+                "moves FEWER bytes than the naive two-pass minimum "
+                "(0.82 vs 1.23 GB at [128,56,56,256]) and its implied "
+                "rate exceeds the measured streaming bandwidth — there "
+                "is no traffic left for a hand kernel to remove.",
+        },
         "dp_step_time": "BASELINE.json's DP=8/32 step-time rows need a pod "
                         "slice; this host exposes ONE chip. Multi-chip "
                         "correctness (all 12 sync modes + tp/pp/sp/ep/fsdp "

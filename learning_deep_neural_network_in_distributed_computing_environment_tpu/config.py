@@ -82,6 +82,10 @@ class Config:
     sequence_parallel: str = "none"  # none | ring | ring_zigzag (causal
     #                                  models only) | all_to_all
     attention_impl: str = "dense"    # dense | flash (Pallas kernel; bert)
+    model_width: int = 0             # EnhancedCNN channel base override
+    #                                  (0 = reference width 64; smaller
+    #                                  widths let the canonical epoch
+    #                                  structure run on CPU-only hosts)
     pp_microbatches: int = 0         # GPipe microbatches (0 => pipe size)
     pp_remat: bool = False           # rematerialize each layer under PP
     #                                  (GPipe-paper memory recipe: save
@@ -200,6 +204,9 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--attention_impl", type=str, default=d.attention_impl,
                    choices=["dense", "flash"],
                    help="attention kernel for bert models (flash = Pallas)")
+    p.add_argument("--model_width", type=int, default=d.model_width,
+                   help="EnhancedCNN channel base override (0 = the "
+                        "reference's 64)")
     p.add_argument("--pp_microbatches", type=int, default=d.pp_microbatches,
                    help="GPipe microbatches when the mesh has a pipe axis "
                         "(0 = pipe size)")

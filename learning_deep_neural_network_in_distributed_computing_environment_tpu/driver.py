@@ -63,6 +63,12 @@ def build_model_for(cfg: Config, num_classes: int, **extra):
         raise NotImplementedError(
             "param dtype other than float32 is not supported yet; use "
             "--compute_dtype for bfloat16 activations/matmuls")
+    if cfg.model_width:
+        if cfg.model != "enhanced_cnn":
+            raise ValueError(
+                f"--model_width applies to --model enhanced_cnn; got "
+                f"{cfg.model}")
+        extra["width"] = cfg.model_width
     return get_model(cfg.model, num_classes=num_classes, dtype=dtype, **extra)
 
 
@@ -126,6 +132,11 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     base_kw: dict[str, Any] = {}   # shared by the dense + train models
     train_kw: dict[str, Any] = {}
     pp = int(mesh.shape.get(PIPE_AXIS, 1))
+    if cfg.pp_remat and pp <= 1:
+        raise ValueError(
+            f"--pp_remat applies under pipeline parallelism (a '{PIPE_AXIS}' "
+            "mesh axis of size >= 2); without one the flag would silently "
+            "do nothing")
     if pp > 1:
         # pipeline parallelism (GPipe schedule, parallel/pp.py): the
         # stacked layer axis shards over 'pipe'; the dense twin must use
