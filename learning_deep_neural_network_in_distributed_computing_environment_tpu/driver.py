@@ -113,6 +113,15 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             axes[DATA_AXIS] = cfg.num_workers
         mesh = build_mesh(axes)
     n = mesh.shape[DATA_AXIS]
+    if jax.process_count() > 1 and n % jax.process_count():
+        # validate once at setup: probe-duration and wall-time attribution
+        # both need whole worker-row blocks per process (probe.py,
+        # _measured_worker_walls) — fail here, before any training, rather
+        # than inside the probe mid-run (advisor r3)
+        raise ValueError(
+            f"worker axis ({n}) must be divisible by the process count "
+            f"({jax.process_count()}): per-process probe/wall attribution "
+            "maps whole worker-row blocks to whole processes")
     rng = np.random.default_rng(cfg.seed)
 
     # --- data ---------------------------------------------------------
@@ -176,6 +185,7 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                 f"{cfg.model}")
         base_kw.update(num_kv_heads=cfg.num_kv_heads)
     ep = int(mesh.shape.get(EXPERT_AXIS, 1))
+    tp = int(mesh.shape.get(MODEL_AXIS, 1))
     if cfg.num_experts > 0:
         # MoE FFN (models/moe.py); with an 'expert' mesh axis the stacked
         # expert weights shard over it (expert parallelism)
@@ -183,32 +193,34 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             raise ValueError(
                 f"--num_experts applies to attention models (bert_*/gpt_*/vit_*/llama_*); "
                 f"got --model {cfg.model}")
-        if (int(mesh.shape.get(MODEL_AXIS, 1)) > 1
-                or cfg.sequence_parallel != "none"):
+        if cfg.sequence_parallel != "none":
             raise NotImplementedError(
-                "MoE does not yet compose with tensor or sequence "
-                "parallelism (per-chunk routing would change the "
-                "capacity and aux-loss semantics)")
+                "MoE does not yet compose with sequence parallelism "
+                "(per-seq-chunk routing would change the capacity and "
+                "aux-loss semantics)")
         base_kw.update(num_experts=cfg.num_experts,
                        capacity_factor=cfg.expert_capacity_factor)
         if ep > 1:
-            from functools import partial
-            from .models.moe import ep_param_specs, pp_ep_param_specs
             train_kw.update(expert_axis=EXPERT_AXIS, ep_size=ep)
-            if pp > 1:
-                # MoE x PP x EP: the stacked layer axis shards over 'pipe'
-                # AND the expert stacks (dim 1 behind the layer dim) over
-                # 'expert'
-                param_specs_fn = partial(pp_ep_param_specs,
-                                         pipe_axis=PIPE_AXIS,
-                                         axis=EXPERT_AXIS)
-            else:
-                param_specs_fn = partial(ep_param_specs, axis=EXPERT_AXIS)
+            if tp == 1:
+                from functools import partial
+                from .models.moe import ep_param_specs, pp_ep_param_specs
+                if pp > 1:
+                    # MoE x PP x EP: the stacked layer axis shards over
+                    # 'pipe' AND the expert stacks (dim 1 behind the layer
+                    # dim) over 'expert'
+                    param_specs_fn = partial(pp_ep_param_specs,
+                                             pipe_axis=PIPE_AXIS,
+                                             axis=EXPERT_AXIS)
+                else:
+                    param_specs_fn = partial(ep_param_specs,
+                                             axis=EXPERT_AXIS)
+            # tp > 1: the TP block below builds the moe-aware Megatron
+            # specs and the expert overlay is applied after it
     elif ep > 1:
         raise ValueError(
             f"mesh has an '{EXPERT_AXIS}' axis but --num_experts is 0")
     model = build_model_for(cfg, num_classes, **base_kw)
-    tp = int(mesh.shape.get(MODEL_AXIS, 1))
     if tp > 1:
         # tensor parallelism (Megatron construction, parallel/tp.py):
         # attention heads + FFN hidden sharded over the 'model' axis; the
@@ -229,6 +241,12 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                                      pipe_axis=PIPE_AXIS, axis=MODEL_AXIS)
         else:
             param_specs_fn = partial(tp_param_specs, axis=MODEL_AXIS)
+        if ep > 1:
+            # MoE x TP (x PP): the Megatron pattern covered the per-expert
+            # F dims; the overlay shards the expert dim over 'expert'
+            from .models.moe import with_expert_overlay
+            param_specs_fn = with_expert_overlay(param_specs_fn,
+                                                 axis=EXPERT_AXIS)
     from .mesh import FSDP_AXIS
     fsdp = int(mesh.shape.get(FSDP_AXIS, 1))
     if fsdp > 1:
@@ -239,22 +257,30 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         # and composes with tensor parallelism (2-D (fsdp, model) sharding:
         # ZeRO-3 claims a free dim of each TP-sharded leaf) and with
         # sequence parallelism (B over fsdp, L over seq).
-        if pp > 1 or ep > 1 or cfg.num_experts > 0:
+        if ep > 1 or cfg.num_experts > 0:
             # MoE even without an expert axis: per-sub-batch routing would
             # change capacity semantics and the psum over fsdp would scale
             # the aux loss by the axis size (same reason as the MoE guard
             # above)
             raise NotImplementedError(
                 f"a '{FSDP_AXIS}' mesh axis does not yet compose with "
-                "pipeline/expert parallelism or MoE")
+                "expert parallelism or MoE")
         if cfg.batch_size % fsdp:
             raise ValueError(
                 f"--batch_size {cfg.batch_size} must be divisible by the "
                 f"'{FSDP_AXIS}' axis size {fsdp} (the batch splits over it)")
+        if pp > 1 and (cfg.pp_microbatches or pp) > 1:
+            mb = cfg.pp_microbatches or pp
+            if (cfg.batch_size // fsdp) % mb:
+                raise ValueError(
+                    f"per-fsdp-slice batch {cfg.batch_size // fsdp} must "
+                    f"be divisible by {mb} pipeline microbatches")
         from .parallel.fsdp import add_fsdp_axis, fsdp_param_specs
-        if tp > 1:
-            # 2-D composition: wrap the spec fn the TP block above chose
-            # with fsdp sharding on a free dim of each large leaf
+        if param_specs_fn is not None:
+            # composition (TP and/or PP specs already chosen): extend with
+            # fsdp sharding on a FREE dim of each large leaf — ZeRO-3
+            # inside Megatron TP (2-D) and/or the GPipe stack (layer dim
+            # stays on 'pipe', fsdp claims another dim)
             base_specs_fn = param_specs_fn
 
             def param_specs_fn(params):

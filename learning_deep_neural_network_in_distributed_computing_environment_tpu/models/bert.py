@@ -140,7 +140,8 @@ class EncoderLayer(nn.Module):
             f = MoEFFN(self.num_experts, self.ffn_dim,
                        capacity_factor=self.capacity_factor,
                        dtype=self.dtype, expert_axis=self.expert_axis,
-                       ep_size=self.ep_size, name="moe")(
+                       ep_size=self.ep_size, tp_size=self.tp_size,
+                       model_axis=self.model_axis, name="moe")(
                            x, train=train, aux_scale=aux_scale)
         else:
             if self.ffn_dim % self.tp_size:
@@ -349,6 +350,19 @@ def _tp_parts(names: list, ndim: int, axis: str):
     LNs, post-reduce biases, the MLM transform) replicated.
     """
     parts = [None] * ndim
+    if "moe" in names:
+        # MoE x TP (models/moe.py): per-expert Megatron sharding on the F
+        # dim — w1 [E, H, F] / b1 [E, F] column-parallel, w2 [E, F, H]
+        # row-parallel; gate and b2 (post-psum bias) replicated.  The
+        # leading E dim is the EXPERT dim (overlaid with the 'expert' axis
+        # by moe.with_expert_overlay when EP is also on).
+        if "w1" in names and ndim == 3:
+            parts[2] = axis
+        elif "b1" in names and ndim == 2:
+            parts[1] = axis
+        elif "w2" in names and ndim == 3:
+            parts[1] = axis
+        return parts
     if "qkv" in names:
         parts[2 if ndim == 4 else 1] = axis
     elif "q" in names:

@@ -66,7 +66,8 @@ class GPTBlock(nn.Module):
             f = MoEFFN(self.num_experts, self.ffn_dim,
                        capacity_factor=self.capacity_factor,
                        dtype=self.dtype, expert_axis=self.expert_axis,
-                       ep_size=self.ep_size, name="moe")(
+                       ep_size=self.ep_size, tp_size=self.tp_size,
+                       model_axis=self.model_axis, name="moe")(
                            f, train=train, aux_scale=aux_scale)
         else:
             if self.ffn_dim % self.tp_size:

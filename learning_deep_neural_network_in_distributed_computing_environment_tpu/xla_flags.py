@@ -34,8 +34,17 @@ def ensure_sequential_cpu_collectives() -> bool:
 
 
 def sequential_cpu_collectives_pinned() -> bool:
-    """Whether XLA_FLAGS carries a setting for the scheduler (either
-    value) — used by the driver to fail fast instead of deadlocking when
-    a hazardous composition is requested on an unpinned CPU backend."""
-    return ("xla_cpu_enable_concurrency_optimized_scheduler"
-            in os.environ.get("XLA_FLAGS", ""))
+    """Whether XLA_FLAGS pins the SEQUENTIAL scheduler — used by the
+    driver to fail fast instead of deadlocking when a hazardous
+    composition is requested on an unpinned CPU backend.
+
+    Only ``...concurrency_optimized_scheduler=false`` counts as pinned:
+    an explicit ``=true`` selects the deadlock-prone scheduler, which is
+    exactly the hazardous configuration (advisor r3 — the old
+    substring-presence check was bypassed by it)."""
+    for flag in os.environ.get("XLA_FLAGS", "").split():
+        if "xla_cpu_enable_concurrency_optimized_scheduler" in flag:
+            _, _, value = flag.partition("=")
+            # TSL bool flag parsing also accepts 0/1 spellings
+            return value.strip().lower() in ("false", "0")
+    return False

@@ -163,6 +163,138 @@ class TestPipelineRemat:
                                    dense["global_train_losses"], rtol=2e-3)
 
 
+class TestOneF1B:
+    """1F1B schedule (VERDICT r3 'next' #3): loss and every gradient tree
+    must equal the dense reference exactly; residual memory must be
+    independent of the microbatch count, unlike autodiff-through-GPipe."""
+
+    PSTAGES, M, MB, D = 4, 8, 2, 16
+
+    def _setup(self, m=None):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.parallel.pp import onef1b_loss
+        m = m or self.M
+        rng = np.random.default_rng(0)
+        W = jnp.asarray(rng.normal(size=(self.PSTAGES, self.D, self.D)) * 0.3,
+                        jnp.float32)
+        H = jnp.asarray(rng.normal(size=(self.D, 3)) * 0.3, jnp.float32)
+        xs = jnp.asarray(rng.normal(size=(m, self.MB, self.D)), jnp.float32)
+        tgt = jnp.asarray(rng.normal(size=(m, self.MB, 3)), jnp.float32)
+
+        def stage_apply(w, x):
+            return jnp.tanh(x @ w[0])
+
+        def loss_fn(hp, y, i):
+            return ((y @ hp - tgt[i]) ** 2).sum() / (m * self.MB)
+
+        return onef1b_loss, stage_apply, loss_fn, W, H, xs, tgt, m
+
+    def _sharded(self, pipe_mesh, m=None):
+        onef1b_loss, stage_apply, loss_fn, W, H, xs, tgt, m = self._setup(m)
+
+        def run(w, hp, x):
+            def inner(wl, hp, x):
+                return onef1b_loss(stage_apply, loss_fn, wl, hp, x,
+                                   axis_name="pipe", num_micro=m)
+            return jax.shard_map(inner, mesh=pipe_mesh,
+                                 in_specs=(P("pipe"), P(), P()),
+                                 out_specs=P())(w, hp, x)
+
+        def ref(w, hp, x):
+            y = x
+            for l in range(self.PSTAGES):
+                y = jnp.tanh(y @ w[l])
+            return ((y @ hp - tgt) ** 2).sum() / (m * self.MB)
+
+        return run, ref, W, H, xs
+
+    def test_loss_and_grads_match_dense(self, pipe_mesh):
+        run, ref, W, H, xs = self._sharded(pipe_mesh)
+        loss, grads = jax.jit(
+            jax.value_and_grad(run, argnums=(0, 1, 2)))(W, H, xs)
+        ref_loss, ref_grads = jax.value_and_grad(
+            ref, argnums=(0, 1, 2))(W, H, xs)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        for g, r, name in zip(grads, ref_grads, ("stage", "head", "xs")):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                       rtol=1e-4, atol=1e-6, err_msg=name)
+
+    def test_eight_stages_matches_dense(self, devices):
+        """p=8 exercises the residual ring-buffer regime where the naive
+        min(p+1, m) sizing clobbers in-flight inputs (code-review r4):
+        grads must still match the dense reference exactly."""
+        mesh8p = Mesh(np.array(devices[:8]), ("pipe",))
+        old = self.PSTAGES
+        self.PSTAGES = 8
+        try:
+            run, ref, W, H, xs = self._sharded(mesh8p, m=16)
+            loss, grads = jax.jit(
+                jax.value_and_grad(run, argnums=(0, 1, 2)))(W, H, xs)
+            ref_loss, ref_grads = jax.value_and_grad(
+                ref, argnums=(0, 1, 2))(W, H, xs)
+            np.testing.assert_allclose(float(loss), float(ref_loss),
+                                       rtol=1e-5)
+            for g, r, name in zip(grads, ref_grads, ("stage", "head", "xs")):
+                np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                           rtol=1e-4, atol=1e-6,
+                                           err_msg=name)
+        finally:
+            self.PSTAGES = old
+
+    def test_odd_microbatch_count(self, pipe_mesh):
+        """M need not be a multiple of the stage count."""
+        run, ref, W, H, xs = self._sharded(pipe_mesh, m=7)
+        loss, grads = jax.jit(
+            jax.value_and_grad(run, argnums=(0, 1, 2)))(W, H, xs)
+        ref_loss, ref_grads = jax.value_and_grad(
+            ref, argnums=(0, 1, 2))(W, H, xs)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(grads[0]),
+                                   np.asarray(ref_grads[0]), rtol=1e-4,
+                                   atol=1e-6)
+
+    def test_residuals_flat_in_microbatch_count(self, pipe_mesh):
+        """vjp-closure-leaf comparison (the --pp_remat test's method):
+        GPipe-through-autodiff residuals grow with M (every schedule
+        step's stage intermediates are saved); the 1F1B custom_vjp's
+        residuals are the three gradient trees — Θ(params + inputs),
+        independent of the per-microbatch activation count.  At
+        M = 2 x stages the 1F1B profile must beat GPipe's."""
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.parallel.pp import gpipe_schedule
+
+        def gpipe_bytes(m):
+            _, stage_apply, loss_fn, W, H, xs, tgt, m = self._setup(m)
+
+            def run(w, hp, x):
+                def inner(wl, hp, x):
+                    outs = gpipe_schedule(
+                        lambda a: jnp.tanh(a @ wl[0]), x, "pipe", m)
+                    return ((outs @ hp - tgt) ** 2).sum() / (m * self.MB)
+                return jax.shard_map(inner, mesh=pipe_mesh,
+                                     in_specs=(P("pipe"), P(), P()),
+                                     out_specs=P())(w, hp, x)
+
+            _, vjp_fn = jax.vjp(run, W, H, xs)
+            return sum(l.nbytes for l in jax.tree_util.tree_leaves(vjp_fn))
+
+        def onef1b_bytes(m):
+            run, _, W, H, xs = self._sharded(pipe_mesh, m)
+            _, vjp_fn = jax.vjp(run, W, H, xs)
+            return sum(l.nbytes for l in jax.tree_util.tree_leaves(vjp_fn))
+
+        m2p = 2 * self.PSTAGES
+        gp8, gp16 = gpipe_bytes(m2p), gpipe_bytes(2 * m2p)
+        f8, f16 = onef1b_bytes(m2p), onef1b_bytes(2 * m2p)
+        # GPipe residuals scale with M; 1F1B's only M-dependence is the
+        # input-cotangent tree (gradient-sized, same shape as xs)
+        assert gp16 > 1.5 * gp8, (gp8, gp16)
+        extra = f16 - f8
+        xs_bytes = 2 * m2p * self.MB * self.D * 4
+        assert extra <= 2 * xs_bytes, (f8, f16, xs_bytes)
+        # the headline claim: at M = 2 x stages, 1F1B beats all-live GPipe
+        assert f8 < gp8, (f8, gp8)
+        assert f16 < gp16, (f16, gp16)
+
+
 class TestDriverPipelineTensorParallel:
     """3-D composition: (data=2, pipe=2, model=2) — the stacked layer axis
     shards over 'pipe' AND the inner Megatron dims over 'model'
@@ -177,6 +309,20 @@ class TestDriverPipelineTensorParallel:
         specs = [str(l.sharding.spec) for l in
                  jax.tree_util.tree_leaves(both["state"].params)]
         assert any("pipe" in s and "model" in s for s in specs)
+
+    def test_driver_fsdp_pp_matches_dense(self, devices):
+        """ZeRO-3 x GPipe (VERDICT r3 'next' #4): params shard over
+        'fsdp' on a free dim AND over 'pipe' on the stacked layer dim;
+        the batch splits over fsdp, microbatches over the pipe schedule —
+        numerics must still match the dense data=2 run."""
+        run = TestDriverPipelineParallel()
+        dense = run._run(devices[:2], {"data": 2})
+        both = run._run(devices[:8], {"data": 2, "fsdp": 2, "pipe": 2})
+        np.testing.assert_allclose(both["global_train_losses"],
+                                   dense["global_train_losses"], rtol=2e-3)
+        specs = [str(l.sharding.spec) for l in
+                 jax.tree_util.tree_leaves(both["state"].params)]
+        assert any("pipe" in s and "fsdp" in s for s in specs)
 
     def test_pp_tp_specs_pattern(self):
         """Stacked leaves get ('pipe', <megatron parts>); the vocab-parallel

@@ -55,6 +55,33 @@ class TestCrossEntropy:
             np.asarray(softmax_cross_entropy(logits, labels)), [expect],
             rtol=1e-6)
 
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_fused_vjp_matches_log_softmax_path(self, dtype):
+        # the production CE is a custom_vjp whose residuals avoid the f32
+        # [.., vocab] log_softmax array; values AND gradients must match
+        # the plain log_softmax twin to float rounding
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.train import (
+            softmax_cross_entropy_reference,
+        )
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.normal(size=(4, 7, 33)) * 3, dtype)
+        labels = jnp.asarray(rng.integers(0, 33, (4, 7)), jnp.int32)
+
+        got = softmax_cross_entropy(logits, labels)
+        want = softmax_cross_entropy_reference(logits, labels)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+
+        def mean_ce(fn):
+            return lambda lg: fn(lg, labels).mean()
+
+        g_got = jax.grad(mean_ce(softmax_cross_entropy))(logits)
+        g_want = jax.grad(mean_ce(softmax_cross_entropy_reference))(logits)
+        assert g_got.dtype == logits.dtype
+        np.testing.assert_allclose(
+            np.asarray(g_got, np.float32), np.asarray(g_want, np.float32),
+            rtol=1e-5, atol=1e-6)
+
 
 class TestEngine:
     def test_round_learns_and_lr_epoch_advances(self, mesh8):
