@@ -87,6 +87,10 @@ class Config:
     #                                  widths let the canonical epoch
     #                                  structure run on CPU-only hosts)
     pp_microbatches: int = 0         # GPipe microbatches (0 => pipe size)
+    pp_schedule: str = "gpipe"       # gpipe | 1f1b (parallel/pp.py): 1f1b
+    #                                  interleaves one backward per
+    #                                  forward, capping in-flight
+    #                                  residuals at O(stages) not O(M)
     pp_remat: bool = False           # rematerialize each layer under PP
     #                                  (GPipe-paper memory recipe: save
     #                                  only layer-boundary activations)
@@ -210,6 +214,11 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--pp_microbatches", type=int, default=d.pp_microbatches,
                    help="GPipe microbatches when the mesh has a pipe axis "
                         "(0 = pipe size)")
+    p.add_argument("--pp_schedule", default=d.pp_schedule,
+                   choices=["gpipe", "1f1b"],
+                   help="pipeline schedule: gpipe (autodiff through the "
+                        "schedule) or 1f1b (interleaved backward, "
+                        "O(stages) residual memory)")
     p.add_argument("--pp_remat", action="store_true",
                    default=d.pp_remat,
                    help="rematerialize each layer under pipeline "

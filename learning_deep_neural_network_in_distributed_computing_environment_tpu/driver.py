@@ -146,6 +146,27 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             f"--pp_remat applies under pipeline parallelism (a '{PIPE_AXIS}' "
             "mesh axis of size >= 2); without one the flag would silently "
             "do nothing")
+    if cfg.pp_schedule == "1f1b":
+        if pp <= 1:
+            raise ValueError(
+                f"--pp_schedule 1f1b applies under pipeline parallelism "
+                f"(a '{PIPE_AXIS}' mesh axis of size >= 2)")
+        if (int(mesh.shape.get(MODEL_AXIS, 1)) > 1
+                or int(mesh.shape.get(EXPERT_AXIS, 1)) > 1
+                or cfg.num_experts > 0
+                or cfg.sequence_parallel != "none"
+                or not cfg.model.startswith(("bert", "gpt", "llama"))):
+            raise NotImplementedError(
+                "--pp_schedule 1f1b currently supports bert_*/gpt_*/"
+                "llama_* under pure pipeline x data parallelism (the "
+                "per-microbatch head+loss runs inside the schedule; "
+                "vocab-parallel / MoE / sequence-parallel heads are "
+                "gpipe-only for now)")
+        from .mesh import FSDP_AXIS as _FS
+        if int(mesh.shape.get(_FS, 1)) > 1:
+            raise NotImplementedError(
+                "--pp_schedule 1f1b does not yet compose with FSDP "
+                "(the schedule gathers no fsdp shards)")
     if pp > 1:
         # pipeline parallelism (GPipe schedule, parallel/pp.py): the
         # stacked layer axis shards over 'pipe'; the dense twin must use
@@ -154,6 +175,14 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             raise ValueError(
                 f"a '{PIPE_AXIS}' mesh axis (pipeline parallelism) applies "
                 f"to attention models (bert_*/gpt_*/vit_*/llama_*); got --model {cfg.model}")
+        mb_count = cfg.pp_microbatches or pp
+        if cfg.batch_size % mb_count:
+            # fail fast here, not with an opaque trace-time reshape error
+            # inside the schedule (code-review r4)
+            raise ValueError(
+                f"--batch_size {cfg.batch_size} must be divisible by the "
+                f"{mb_count} pipeline microbatches (--pp_microbatches, "
+                f"0 => the '{PIPE_AXIS}' axis size {pp})")
         if cfg.sequence_parallel != "none":
             # SP x PP is supported, but on an UNPINNED CPU backend the
             # concurrency-optimized thunk executor can deadlock on the
@@ -233,14 +262,20 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         from functools import partial
         from .models.bert import pp_tp_param_specs, tp_param_specs
         train_kw.update(tp_size=tp, model_axis=MODEL_AXIS)
+        # GPT's TIED head: sharding its embedding table's vocab dim makes
+        # both the lookup (masked psum) and the decode (local logits
+        # slice) vocab-parallel — models/gpt.py _embed
+        tok = dict(shard_tok_emb=cfg.model.startswith("gpt"))
         if pp > 1:
             # 2-D composition: the stacked layer axis shards over 'pipe'
             # AND the inner Megatron dims over 'model' (the dense twin
             # keeps the same stacked structure via scan_layers)
             param_specs_fn = partial(pp_tp_param_specs,
-                                     pipe_axis=PIPE_AXIS, axis=MODEL_AXIS)
+                                     pipe_axis=PIPE_AXIS, axis=MODEL_AXIS,
+                                     **tok)
         else:
-            param_specs_fn = partial(tp_param_specs, axis=MODEL_AXIS)
+            param_specs_fn = partial(tp_param_specs, axis=MODEL_AXIS,
+                                     **tok)
         if ep > 1:
             # MoE x TP (x PP): the Megatron pattern covered the per-expert
             # F dims; the overlay shards the expert dim over 'expert'

@@ -273,11 +273,22 @@ class BertForMLM(nn.Module):
     vocab_parallel_head = True
 
     @nn.compact
-    def __call__(self, input_ids, *, train: bool = False):
+    def __call__(self, input_ids, *, train: bool = False,
+                 mode: str = "full"):
+        """``mode`` partitions the forward for the 1F1B engine path
+        (parallel/pp.py): 'embed' -> embedded activations, 'stage' ->
+        apply this device's local scanned layers to activations (no
+        pipeline schedule), 'head' -> MLM transform + decode on
+        activations.  'full' (default) is the ordinary forward; init
+        always uses it so every mode shares one parameter structure."""
         if self.tp_size > 1 and self.num_classes % self.tp_size:
             raise ValueError(
                 f"vocab size {self.num_classes} not divisible by tp_size "
                 f"{self.tp_size} (vocab-parallel MLM head)")
+        if mode == "stage":
+            return self._encode_scanned(input_ids, train, as_stage=True)
+        if mode == "head":
+            return self._mlm_head(input_ids)
         b, l = input_ids.shape
         tok = nn.Embed(self.num_classes, self.hidden, embedding_init=_init,
                        name="tok_emb")(input_ids)
@@ -291,6 +302,8 @@ class BertForMLM(nn.Module):
                        name="pos_emb")(pos_ids[None, :])
         x = nn.LayerNorm(epsilon=1e-12, name="ln_emb")(tok + pos)
         x = jnp.asarray(x, self.dtype)
+        if mode == "embed":
+            return x
         if self.scan_layers:
             x = self._encode_scanned(x, train)
         else:
@@ -306,6 +319,9 @@ class BertForMLM(nn.Module):
                                  ep_size=self.ep_size,
                                  capacity_factor=self.capacity_factor,
                                  name=f"layer{i}")(x, train=train)
+        return self._mlm_head(x)
+
+    def _mlm_head(self, x):
         # untied MLM head: transform + LayerNorm + decode.  The head runs
         # in the compute dtype: at bf16 the [*, hidden, vocab] decode
         # matmul hits the MXU's full bf16 rate and the [B, L, vocab]
@@ -324,10 +340,11 @@ class BertForMLM(nn.Module):
         return nn.Dense(self.num_classes // self.tp_size, kernel_init=_init,
                         dtype=self.dtype, name="mlm_decoder")(x)
 
-    def _encode_scanned(self, x, train: bool):
+    def _encode_scanned(self, x, train: bool, as_stage: bool = False):
         return apply_scanned_stack(
             _ScanLayer, x, num_layers=self.num_layers, pp_size=self.pp_size,
-            pipeline_axis=self.pipeline_axis, remat=self.remat,
+            pipeline_axis=None if as_stage else self.pipeline_axis,
+            remat=self.remat,
             num_microbatches=self.num_microbatches, train=train,
             num_heads=self.num_heads, ffn_dim=self.ffn_dim,
             dtype=self.dtype, attention_impl=self.attention_impl,
@@ -337,10 +354,17 @@ class BertForMLM(nn.Module):
             capacity_factor=self.capacity_factor)
 
 
-def _tp_parts(names: list, ndim: int, axis: str):
+def _tp_parts(names: list, ndim: int, axis: str,
+              shard_tok_emb: bool = False):
     """Megatron sharding pattern for one leaf, as a parts list of length
     ``ndim`` (the UNSTACKED leaf rank — callers with a leading layer dim
     pass ``leaf.ndim - 1``).
+
+    ``shard_tok_emb``: shard the token-embedding table's VOCAB dim — the
+    vocab-parallel TIED head (GPT: the same table is the decode matrix,
+    so sharding it shards both the lookup and the logits; models/gpt.py
+    ``_embed``).  BERT/Llama keep their lookup tables replicated (their
+    decodes are separate vocab-parallel Dense kernels).
 
     qkv kernel [H, 3, heads, hd] / bias [3, heads, hd]: heads dim sharded;
     attn out kernel [heads, hd, H] and ffn_out kernel [F, H]: dim 0 sharded
@@ -382,22 +406,26 @@ def _tp_parts(names: list, ndim: int, axis: str):
     elif "mlm_decoder" in names or "lm_head" in names:
         # vocab-parallel decode: kernel [H, V] / bias [V]
         parts[1 if ndim == 2 else 0] = axis
+    elif shard_tok_emb and "tok_emb" in names and ndim == 2:
+        parts[0] = axis              # embedding table [V, H]: V sharded
     return parts
 
 
-def tp_param_specs(params, axis: str = "model"):
+def tp_param_specs(params, axis: str = "model", *,
+                   shard_tok_emb: bool = False):
     """PartitionSpec tree sharding BERT parameters over the TP ``axis``
     (no worker axis — the engine prepends it); pattern in ``_tp_parts``."""
     from jax.sharding import PartitionSpec as P
 
     def spec(path, leaf):
         names = [getattr(p, "key", str(p)) for p in path]
-        return P(*_tp_parts(names, leaf.ndim, axis))
+        return P(*_tp_parts(names, leaf.ndim, axis,
+                            shard_tok_emb=shard_tok_emb))
     return jax.tree_util.tree_map_with_path(spec, params)
 
 
 def pp_tp_param_specs(params, *, pipe_axis: str = "pipe",
-                      axis: str = "model"):
+                      axis: str = "model", shard_tok_emb: bool = False):
     """PartitionSpec tree for a ``scan_layers`` model under BOTH pipeline
     and tensor parallelism: leaves under the stacked ``layers`` collection
     shard their leading (layer) dim over ``pipe_axis`` AND their inner dims
@@ -409,5 +437,6 @@ def pp_tp_param_specs(params, *, pipe_axis: str = "pipe",
         names = [getattr(p, "key", str(p)) for p in path]
         if "layers" in names:
             return P(pipe_axis, *_tp_parts(names, leaf.ndim - 1, axis))
-        return P(*_tp_parts(names, leaf.ndim, axis))
+        return P(*_tp_parts(names, leaf.ndim, axis,
+                            shard_tok_emb=shard_tok_emb))
     return jax.tree_util.tree_map_with_path(spec, params)

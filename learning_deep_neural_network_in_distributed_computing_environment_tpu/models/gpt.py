@@ -147,17 +147,38 @@ class GPTForCausalLM(nn.Module):
     ep_size: int = 1
     capacity_factor: float = 1.25
 
-    # tied head: logits always cover the FULL vocab (sharding the table
-    # would also shard the input embedding lookup — a later optimization)
-    vocab_parallel_head = False
+    # tied head, vocab-parallel under TP (r4): the embedding table shards
+    # over 'model' on the VOCAB dim, the lookup masks+psums the local
+    # rows, and attend() emits the LOCAL vocab slice of the logits — the
+    # Megatron vocab-parallel construction applied to a TIED head, so the
+    # full [B, L, V] logits never materialize on one device and the
+    # engine's loss goes through vocab_parallel_token_stats
+    vocab_parallel_head = True
 
     @nn.compact
-    def __call__(self, input_ids, *, train: bool = False):
-        b, l = input_ids.shape
-        tok_emb = nn.Embed(self.num_classes, self.hidden,
+    def __call__(self, input_ids, *, train: bool = False,
+                 mode: str = "full"):
+        """``mode`` partitions the forward for the 1F1B engine path
+        (parallel/pp.py): 'embed' -> embedded activations, 'stage' ->
+        apply this device's local scanned layers to activations (no
+        pipeline schedule), 'head' -> final LN + tied decode on
+        activations.  'full' (default) is the ordinary forward; init
+        always uses it so every mode shares one parameter structure."""
+        if self.tp_size > 1 and self.num_classes % self.tp_size:
+            raise ValueError(
+                f"vocab size {self.num_classes} not divisible by tp_size "
+                f"{self.tp_size} (vocab-parallel tied head)")
+        tok_emb = nn.Embed(self.num_classes // self.tp_size, self.hidden,
                            embedding_init=_init, dtype=self.dtype,
                            name="tok_emb")
-        tok = tok_emb(input_ids)
+        if mode == "stage":
+            return self._decode_scanned(input_ids, train, as_stage=True)
+        if mode == "head":
+            x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype,
+                             name="ln_f")(input_ids)
+            return tok_emb.attend(x)
+        b, l = input_ids.shape
+        tok = self._embed(tok_emb, input_ids)
         pos_ids = jnp.arange(l)
         if self.axis_name is not None:
             # sequence-parallel: this device holds chunk axis_index of the
@@ -167,6 +188,8 @@ class GPTForCausalLM(nn.Module):
         pos = nn.Embed(self.max_len, self.hidden, embedding_init=_init,
                        dtype=self.dtype, name="pos_emb")(pos_ids[None, :])
         x = jnp.asarray(tok + pos, self.dtype)
+        if mode == "embed":
+            return x
         if self.scan_layers:
             x = self._decode_scanned(x, train)
         else:
@@ -181,14 +204,32 @@ class GPTForCausalLM(nn.Module):
                              capacity_factor=self.capacity_factor,
                              name=f"layer{i}")(x, train=train)
         x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln_f")(x)
-        # tied LM head: logits = x @ tok_emb^T (shares the embedding table)
+        # tied LM head: logits = x @ tok_emb^T (shares the embedding
+        # table; the LOCAL vocab slice under tensor parallelism)
         return tok_emb.attend(x)
 
-    def _decode_scanned(self, x, train: bool):
+    def _embed(self, tok_emb, input_ids):
+        """Token lookup; under TP each shard holds vocab rows
+        [idx*V/tp, (idx+1)*V/tp) and the masked local lookups psum to the
+        full embedding (transpose: each shard's table gradient is its
+        local scatter-add — stays sharded)."""
+        if self.tp_size <= 1:
+            return tok_emb(input_ids)
+        from jax import lax
+        v_local = self.num_classes // self.tp_size
+        off = lax.axis_index(self.model_axis) * v_local
+        loc = input_ids - off
+        hit = (loc >= 0) & (loc < v_local)
+        tok = tok_emb(jnp.clip(loc, 0, v_local - 1))
+        tok = jnp.where(hit[..., None], tok, jnp.zeros_like(tok))
+        return lax.psum(tok, self.model_axis)
+
+    def _decode_scanned(self, x, train: bool, as_stage: bool = False):
         from .bert import apply_scanned_stack
         return apply_scanned_stack(
             _ScanBlock, x, num_layers=self.num_layers, pp_size=self.pp_size,
-            pipeline_axis=self.pipeline_axis, remat=self.remat,
+            pipeline_axis=None if as_stage else self.pipeline_axis,
+            remat=self.remat,
             num_microbatches=self.num_microbatches, train=train,
             num_heads=self.num_heads, ffn_dim=self.ffn_dim,
             dtype=self.dtype, attention_impl=self.attention_impl,

@@ -74,7 +74,8 @@ class LlamaBlock(nn.Module):
             f = MoEFFN(self.num_experts, self.ffn_dim,
                        capacity_factor=self.capacity_factor,
                        dtype=self.dtype, expert_axis=self.expert_axis,
-                       ep_size=self.ep_size, name="moe")(
+                       ep_size=self.ep_size, tp_size=self.tp_size,
+                       model_axis=self.model_axis, name="moe")(
                            f, train=train, aux_scale=aux_scale)
         else:
             if self.ffn_dim % self.tp_size:
@@ -163,19 +164,35 @@ class LlamaForCausalLM(nn.Module):
     vocab_parallel_head = True
 
     @nn.compact
-    def __call__(self, input_ids, *, train: bool = False):
+    def __call__(self, input_ids, *, train: bool = False,
+                 mode: str = "full"):
+        """``mode`` partitions the forward for the 1F1B engine path
+        (parallel/pp.py): 'embed' / 'stage' / 'head' — see
+        ``bert.BertForMLM.__call__``."""
         if self.tp_size > 1 and self.num_classes % self.tp_size:
             raise ValueError(
                 f"vocab size {self.num_classes} not divisible by tp_size "
                 f"{self.tp_size} (vocab-parallel LM head)")
-        x = nn.Embed(self.num_classes, self.hidden, embedding_init=_init,
-                     dtype=self.dtype, name="tok_emb")(input_ids)
+        if mode == "head":
+            return self._lm_head(input_ids)
+        if mode != "stage":
+            x = nn.Embed(self.num_classes, self.hidden,
+                         embedding_init=_init, dtype=self.dtype,
+                         name="tok_emb")(input_ids)
+            if mode == "embed":
+                return x
+        else:
+            if not self.scan_layers:
+                raise ValueError("mode='stage' requires scan_layers=True")
+            x = input_ids  # activations: apply the local stage layers only
         # no position table: RoPE inside attention carries all position info
         if self.scan_layers:
             from .bert import apply_scanned_stack
             x = apply_scanned_stack(
                 _ScanLlamaBlock, x, num_layers=self.num_layers,
-                pp_size=self.pp_size, pipeline_axis=self.pipeline_axis,
+                pp_size=self.pp_size,
+                pipeline_axis=None if mode == "stage"
+                else self.pipeline_axis,
                 remat=self.remat,
                 num_microbatches=self.num_microbatches, train=train,
                 num_heads=self.num_heads, ffn_dim=self.ffn_dim,
@@ -201,6 +218,11 @@ class LlamaForCausalLM(nn.Module):
                                ep_size=self.ep_size,
                                capacity_factor=self.capacity_factor,
                                name=f"layer{i}")(x, train=train)
+        if mode == "stage":
+            return x
+        return self._lm_head(x)
+
+    def _lm_head(self, x):
         x = nn.RMSNorm(epsilon=1e-5, dtype=self.dtype, name="rms_f")(x)
         if self.tp_size > 1:
             x = copy_to_tp_region(x, self.model_axis)

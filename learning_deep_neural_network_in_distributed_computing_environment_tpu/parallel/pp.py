@@ -209,20 +209,42 @@ def onef1b_schedule(stage_fn: Callable, loss_fn: Callable, stage_params,
     structure on every stage; ``stage_params`` are the pipe-sharded local
     layers).  ``loss_fn(head_params, y, i)``: per-microbatch scalar loss
     partial (head + CE for microbatch ``i``; contributions must SUM to the
-    global loss — divide by the data-derived global denominator inside).
+    global loss — divide by the data-derived global denominator inside);
+    it may return ``(loss, aux)`` where ``aux`` is a pytree of per-
+    microbatch metric sums (correct counts, token totals) accumulated
+    across microbatches and NOT differentiated.
     ``xs`` [M, mb, ...]: microbatched schedule inputs (post-embedding).
 
-    Returns ``(loss, gs, gh, gxs)``: the scalar loss and the gradients
-    w.r.t. stage_params / head_params / xs, all replicated along
-    ``axis_name``.  Every tick recomputes the bwd slot's stage forward
-    from the stored stage INPUT (per-layer remat by construction), so the
-    in-flight residual per stage is ``min(P - s, M)`` stage inputs."""
+    Returns ``(loss, aux, gs, gh, gxs)``: scalar loss, summed aux, and
+    the gradients w.r.t. stage_params / head_params / xs, all replicated
+    along ``axis_name``.  Every tick recomputes the bwd slot's stage
+    forward from the stored stage INPUT (per-layer remat by
+    construction), so the in-flight residuals are O(stages) inputs."""
     p = lax.axis_size(axis_name)
     s = lax.axis_index(axis_name)
     m = num_micro
     # last bwd lands on stage 0 at tick 2(m-1) + 2(p-1)
     ticks = 2 * m + 2 * p - 3
-    vary = lambda a: lax.pcast(a, (axis_name,), to="varying")
+
+    # Inside the engine the schedule runs under additional mesh axes (the
+    # per-worker 'data' axis, at least), so every fresh zero / seed must
+    # carry xs' full varying-axes set PLUS the pipe axis — otherwise the
+    # scan carry types (and the vjp seed type) mismatch the body outputs.
+    want_vma = set(getattr(jax.typeof(xs), "vma", ())) | {axis_name}
+
+    def _vary_leaf(a):
+        missing = tuple(sorted(
+            want_vma - set(getattr(jax.typeof(a), "vma", ()))))
+        return lax.pcast(a, missing, to="varying") if missing else a
+
+    def vary(tree):
+        return jax.tree_util.tree_map(_vary_leaf, tree)
+
+    def loss_aux(hp, yy, i):
+        out = loss_fn(hp, yy, i)
+        return out if isinstance(out, tuple) else (out, {})
+
+    aux_struct = jax.eval_shape(loss_aux, head_params, xs[0], 0)[1]
 
     # ring-buffer size: at stage s the fwd index runs ahead of the oldest
     # un-backwarded microbatch by up to 3(p-1-s)/2 in the steady state
@@ -241,11 +263,21 @@ def onef1b_schedule(stage_fn: Callable, loss_fn: Callable, stage_params,
         gh=vary(_zeros_tree(head_params)),
         gxs=vary(jnp.zeros_like(xs)),
         loss=vary(jnp.zeros((), jnp.float32)),
+        aux=jax.tree_util.tree_map(
+            lambda sd: vary(jnp.zeros(sd.shape, sd.dtype)), aux_struct),
     )
 
     def tick(carry, t):
         fi, f_ok = _valid_fwd_index(t, s, p, m)
         bi, b_ok = _valid_bwd_index(t, s, p, m)
+
+        # Bubble slots are SKIPPED with lax.cond, not masked: stage_fn is
+        # collective-free under the 1f1b guards (no SP ring, no MoE
+        # psum), so per-device branch divergence is legal — the only
+        # cross-device sync points are the two ppermutes below, and the
+        # schedule stays lockstep on them.  This roughly halves the
+        # schedule's compute vs compute-then-mask (code-review r4: the
+        # head fwd+vjp alone otherwise runs 2M+2P-3 times for M seeds).
 
         # ---- fwd slot -------------------------------------------------
         # stage 0 injects xs[fi]; others consume the queue — depth 1 while
@@ -254,30 +286,47 @@ def onef1b_schedule(stage_fn: Callable, loss_fn: Callable, stage_params,
         x_in = jnp.where(s == 0, x_own,
                          jnp.where(fi <= p - 1 - s, carry["q1"],
                                    carry["q2"]))
-        y = stage_fn(stage_params, x_in)
+        y = lax.cond(f_ok, lambda x: vary(stage_fn(stage_params, x)),
+                     lambda x: vary(jnp.zeros_like(x)), x_in)
         res = jnp.where(f_ok, carry["res"].at[fi % nres].set(x_in),
                         carry["res"])
 
         # ---- last stage: per-microbatch head + loss + cotangent seed --
         is_last = s == p - 1
+        seed_ok = is_last & f_ok
 
         def head_loss(hp, yy):
-            return loss_fn(hp, yy, fi)
+            return loss_aux(hp, yy, fi)
 
-        # differentiate w.r.t. a VARYING view of the (replicated) head
-        # params: varying-axes autodiff would auto-psum the cotangent of
-        # an invariant primal over the pipe axis, summing the other
-        # stages' masked-garbage head grads in BEFORE the seed_ok mask
-        # could act (and paying a collective per tick); a varying primal
-        # keeps the cotangent local, and the single psum at the end
-        # recovers the replicated gradient from the last stage's zeros-
-        # elsewhere accumulation
-        l_val, pull = jax.vjp(head_loss, vary(head_params), y)
-        dh_i, dy_i = pull(vary(jnp.ones((), l_val.dtype)))
-        seed_ok = is_last & f_ok
-        loss = carry["loss"] + jnp.where(seed_ok, l_val, 0.0)
-        gh = jax.tree_util.tree_map(
-            lambda a, d: a + jnp.where(seed_ok, d, 0.0), carry["gh"], dh_i)
+        def do_head(yy):
+            # differentiate w.r.t. a VARYING view of the (replicated)
+            # head params: varying-axes autodiff would auto-psum the
+            # cotangent of an invariant primal over the pipe axis,
+            # summing the other stages' garbage head grads in (and
+            # paying a collective per tick); a varying primal keeps the
+            # cotangent local, and the single psum at the end recovers
+            # the replicated gradient from the zeros-elsewhere sum
+            l_val, pull, aux_i = jax.vjp(head_loss, vary(head_params),
+                                         yy, has_aux=True)
+            dh_i, dy_i = pull(vary(jnp.ones((), l_val.dtype)))
+            # vary() everything: branch avals must match no_head exactly,
+            # and aux components that depend only on data (e.g. a token
+            # count) would otherwise carry a smaller varying set
+            return vary(l_val), vary(aux_i), vary(dh_i), vary(dy_i)
+
+        def no_head(yy):
+            return (vary(jnp.zeros((), jnp.float32)),
+                    jax.tree_util.tree_map(
+                        lambda sd: vary(jnp.zeros(sd.shape, sd.dtype)),
+                        aux_struct),
+                    vary(_zeros_tree(head_params)),
+                    vary(jnp.zeros_like(yy)))
+
+        l_val, aux_i, dh_i, dy_i = lax.cond(seed_ok, do_head, no_head, y)
+        loss = carry["loss"] + l_val
+        aux = jax.tree_util.tree_map(lambda a, v: a + v, carry["aux"],
+                                     aux_i)
+        gh = jax.tree_util.tree_map(lambda a, d: a + d, carry["gh"], dh_i)
 
         # ---- bwd slot -------------------------------------------------
         # cotangent source: the last stage seeds its own (fwd and bwd hit
@@ -288,12 +337,21 @@ def onef1b_schedule(stage_fn: Callable, loss_fn: Callable, stage_params,
         # read the UPDATED buffer: the last stage's bwd hits the microbatch
         # whose input was stored by THIS tick's fwd slot
         x_res = res[bi % nres]
-        # recompute this stage's forward from the stored input (remat)
-        # and pull the cotangent back through it
-        _, spull = jax.vjp(stage_fn, stage_params, x_res)
-        ds_i, dx_i = spull(g_in.astype(y.dtype))
-        gs = jax.tree_util.tree_map(
-            lambda a, d: a + jnp.where(b_ok, d, 0.0), carry["gs"], ds_i)
+
+        def do_bwd(args):
+            g, x = args
+            # recompute this stage's forward from the stored input
+            # (remat) and pull the cotangent back through it
+            ds, dx = jax.vjp(stage_fn, stage_params, x)[1](
+                g.astype(x.dtype))
+            return vary(ds), vary(dx)
+
+        def no_bwd(args):
+            return (vary(_zeros_tree(stage_params)),
+                    vary(jnp.zeros_like(x_res)))
+
+        ds_i, dx_i = lax.cond(b_ok, do_bwd, no_bwd, (g_in, x_res))
+        gs = jax.tree_util.tree_map(lambda a, d: a + d, carry["gs"], ds_i)
         gxs = jnp.where(b_ok & (s == 0),
                         carry["gxs"].at[bi].add(dx_i), carry["gxs"])
 
@@ -305,15 +363,16 @@ def onef1b_schedule(stage_fn: Callable, loss_fn: Callable, stage_params,
         gq = lax.ppermute(jnp.where(b_ok, dx_i, jnp.zeros_like(dx_i)),
                           axis_name, bwd_ring)
         return dict(q1=q1, q2=carry["q1"], gq=gq, res=res, gs=gs, gh=gh,
-                    gxs=gxs, loss=loss), None
+                    gxs=gxs, loss=loss, aux=aux), None
 
     carry, _ = lax.scan(tick, carry0, jnp.arange(ticks))
-    # loss / head grads live on the last stage, input grads on stage 0:
-    # psum replicates them (other stages contributed zeros)
+    # loss / aux / head grads live on the last stage, input grads on
+    # stage 0: psum replicates them (other stages contributed zeros)
     loss = lax.psum(carry["loss"], axis_name)
+    aux = lax.psum(carry["aux"], axis_name)
     gh = lax.psum(carry["gh"], axis_name)
     gxs = lax.psum(carry["gxs"], axis_name)
-    return loss, carry["gs"], gh, gxs
+    return loss, aux, carry["gs"], gh, gxs
 
 
 def _zeros_tree(tree):
@@ -324,24 +383,27 @@ def _zeros_tree(tree):
 def onef1b_loss(stage_fn: Callable, loss_fn: Callable, stage_params,
                 head_params, xs: jnp.ndarray, *, axis_name: str,
                 num_micro: int):
-    """Differentiable entry point: ``loss = onef1b_loss(...)`` behaves
-    like a plain scalar-valued function of (stage_params, head_params,
-    xs) under ``jax.grad`` / ``value_and_grad``, but its forward pass IS
-    the fwd+bwd 1F1B schedule and its backward is three scalar scalings
-    of the stored gradients (exact: gradients are linear in the scalar
-    upstream cotangent)."""
+    """Differentiable entry point: ``(loss, aux) = onef1b_loss(...)``
+    behaves like a plain function of (stage_params, head_params, xs)
+    under ``jax.grad`` / ``value_and_grad`` (differentiate the loss;
+    ``aux`` carries accumulated metric sums and is not differentiated),
+    but its forward pass IS the fwd+bwd 1F1B schedule and its backward is
+    three scalings of the stored gradients (exact: gradients are linear
+    in the scalar upstream cotangent)."""
 
     @jax.custom_vjp
     def f(sp, hp, x):
-        return onef1b_schedule(stage_fn, loss_fn, sp, hp, x,
-                               axis_name, num_micro)[0]
+        out = onef1b_schedule(stage_fn, loss_fn, sp, hp, x,
+                              axis_name, num_micro)
+        return out[0], out[1]
 
     def fwd(sp, hp, x):
-        loss, gs, gh, gxs = onef1b_schedule(
+        loss, aux, gs, gh, gxs = onef1b_schedule(
             stage_fn, loss_fn, sp, hp, x, axis_name, num_micro)
-        return loss, (gs, gh, gxs)
+        return (loss, aux), (gs, gh, gxs)
 
-    def bwd(resid, gbar):
+    def bwd(resid, cot):
+        gbar = cot[0]  # aux cotangent (cot[1]) is discarded: metrics only
         gs, gh, gxs = resid
         scale = lambda tree: jax.tree_util.tree_map(
             lambda l: (gbar * l.astype(gbar.dtype)).astype(l.dtype), tree)

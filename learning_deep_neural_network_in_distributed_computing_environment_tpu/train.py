@@ -229,6 +229,11 @@ class LocalSGDEngine:
         from .mesh import PIPE_AXIS
         self.pipe_axis = (
             PIPE_AXIS if int(mesh.shape.get(PIPE_AXIS, 1)) > 1 else None)
+        # --pp_schedule 1f1b: the train step runs the manual 1F1B
+        # schedule (parallel/pp.py onef1b_loss) instead of autodiff
+        # through the GPipe scan; eval keeps the GPipe forward
+        self.onef1b = (self.pipe_axis is not None
+                       and getattr(cfg, "pp_schedule", "gpipe") == "1f1b")
         # tensor parallelism: params(single-replica) -> PartitionSpec tree
         # over the 'model' axis (e.g. models.bert.tp_param_specs)
         self.param_specs_fn = param_specs_fn
@@ -401,7 +406,50 @@ class LocalSGDEngine:
             return vocab_parallel_token_stats(out, yb, mb, self.vp_axis)
         return masked_token_stats(out, yb, mb)
 
+    def _onef1b_loss_and_metrics(self, params, batch_stats, xb, yb, mb):
+        """1F1B train-step loss: embeddings and the per-microbatch head +
+        CE run through ``parallel.pp.onef1b_loss`` (the fwd+bwd schedule
+        as a custom-VJP function), so an outer ``value_and_grad`` over
+        ``params`` composes: stage grads come from the schedule, while
+        embedding grads flow through the returned input cotangent (tied
+        heads — GPT's tok_emb — get both contributions summed by the
+        chain rule automatically).  The masked-mean loss stays exact
+        because its denominator is data-derived and computed up front."""
+        from .parallel.pp import onef1b_loss
+        tm = self.train_model
+        mnum = tm.num_microbatches or tm.pp_size
+        b = xb.shape[0]
+        emb = tm.apply({"params": params}, xb, train=True, mode="embed")
+        xs = emb.reshape(mnum, b // mnum, *emb.shape[1:])
+        ys = yb.reshape(mnum, b // mnum, *yb.shape[1:])
+        w = mb.reshape(mb.shape + (1,) * (yb.ndim - mb.ndim))
+        w = jnp.broadcast_to(w, yb.shape).astype(jnp.float32) * (yb >= 0)
+        ws = w.reshape(mnum, b // mnum, *w.shape[1:])
+        denom = jnp.maximum(w.sum(), 1.0)  # data-derived: known pre-schedule
+        stage_params = params["layers"]
+        head_params = {k: v for k, v in params.items() if k != "layers"}
+
+        def stage_fn(sp, x):
+            return tm.apply({"params": {"layers": sp}}, x, train=True,
+                            mode="stage")
+
+        def loss_fn(hp, y, i):
+            logits = tm.apply({"params": hp}, y, train=True, mode="head")
+            ce = softmax_cross_entropy(logits, jnp.maximum(ys[i], 0))
+            w_i = ws[i]
+            loss_i = (ce * w_i).sum() / denom
+            correct_i = ((logits.argmax(-1) == ys[i]) * w_i).sum()
+            return loss_i, (correct_i, w_i.sum())
+
+        loss, (correct, total) = onef1b_loss(
+            stage_fn, loss_fn, stage_params, head_params, xs,
+            axis_name=self.pipe_axis, num_micro=mnum)
+        return loss, (batch_stats, correct, total)
+
     def _loss_and_metrics(self, params, batch_stats, xb, yb, mb):
+        if self.onef1b:
+            return self._onef1b_loss_and_metrics(params, batch_stats,
+                                                 xb, yb, mb)
         if self.fsdp_axis:
             # ZeRO-3: shards -> full params just-in-time; grad of this
             # all_gather is reduce-scatter, so each device's gradient tree

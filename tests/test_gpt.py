@@ -126,3 +126,28 @@ class TestGPT:
                      aggregation_by="weights", seed=1, **extra)
         res = train_global(cfg, mesh=mesh, progress=False)
         assert np.isfinite(res["global_train_losses"]).all()
+
+    def test_gpt_tp_vocab_parallel_tied_head_matches_dense(self, devices):
+        """GPT x TP shards the TIED embedding table's vocab dim (r4):
+        masked-psum lookup + local-slice logits must compute exactly the
+        dense function — trajectories equal, table physically sharded."""
+        import jax
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.mesh import build_mesh
+
+        def run(axes, devs):
+            cfg = Config(model="gpt_tiny", dataset="synthetic_lm",
+                         epochs_global=2, epochs_local=1, batch_size=8,
+                         limit_train_samples=128, limit_eval_samples=32,
+                         compute_dtype="float32", augment=False,
+                         aggregation_by="weights", seed=5)
+            return train_global(cfg, mesh=build_mesh(axes, devs),
+                                progress=False)
+
+        dense = run({"data": 2}, devices[:2])
+        tp = run({"data": 2, "model": 2}, devices[:4])
+        np.testing.assert_allclose(tp["global_train_losses"],
+                                   dense["global_train_losses"], rtol=2e-3)
+        emb = tp["state"].params["tok_emb"]["embedding"]
+        assert "model" in str(emb.sharding.spec)

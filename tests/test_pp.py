@@ -99,12 +99,13 @@ class TestDriverPipelineParallel:
         from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
         from learning_deep_neural_network_in_distributed_computing_environment_tpu.mesh import build_mesh
         mesh = build_mesh(mesh_axes, devices)
-        cfg = Config(model="bert_tiny", dataset="synthetic_mlm",
-                     epochs_global=2, epochs_local=1, batch_size=8,
-                     limit_train_samples=128, limit_eval_samples=32,
-                     compute_dtype="float32", augment=False,
-                     aggregation_by="weights", seed=7, **kw)
-        return train_global(cfg, mesh=mesh, progress=False)
+        base = dict(model="bert_tiny", dataset="synthetic_mlm",
+                    epochs_global=2, epochs_local=1, batch_size=8,
+                    limit_train_samples=128, limit_eval_samples=32,
+                    compute_dtype="float32", augment=False,
+                    aggregation_by="weights", seed=7)
+        base.update(kw)
+        return train_global(Config(**base), mesh=mesh, progress=False)
 
     def test_matches_dense_run(self, devices):
         dense = self._run(devices[:2], {"data": 2})
@@ -194,7 +195,7 @@ class TestOneF1B:
         def run(w, hp, x):
             def inner(wl, hp, x):
                 return onef1b_loss(stage_apply, loss_fn, wl, hp, x,
-                                   axis_name="pipe", num_micro=m)
+                                   axis_name="pipe", num_micro=m)[0]
             return jax.shard_map(inner, mesh=pipe_mesh,
                                  in_specs=(P("pipe"), P(), P()),
                                  out_specs=P())(w, hp, x)
@@ -251,6 +252,30 @@ class TestOneF1B:
         np.testing.assert_allclose(np.asarray(grads[0]),
                                    np.asarray(ref_grads[0]), rtol=1e-4,
                                    atol=1e-6)
+
+    def test_driver_1f1b_matches_dense(self, devices):
+        """--pp_schedule 1f1b end to end: the engine's train step runs
+        the manual schedule (head+CE per microbatch inside), and the
+        loss trajectory must still match the dense data=2 run."""
+        run = TestDriverPipelineParallel()
+        dense = run._run(devices[:2], {"data": 2})
+        pp = run._run(devices[:4], {"data": 2, "pipe": 2},
+                      pp_schedule="1f1b", pp_microbatches=4)
+        np.testing.assert_allclose(pp["global_train_losses"],
+                                   dense["global_train_losses"], rtol=2e-3)
+        assert pp["global_train_losses"][-1] < pp["global_train_losses"][0]
+
+    def test_driver_1f1b_gpt_tied_head(self, devices):
+        """GPT under 1f1b: the tied tok_emb gets gradient contributions
+        from BOTH the in-schedule head and the out-of-schedule embedding
+        lookup — trajectory must match the dense twin."""
+        run = TestDriverPipelineParallel()
+        kw = dict(model="gpt_tiny", dataset="synthetic_lm")
+        dense = run._run(devices[:2], {"data": 2}, **kw)
+        pp = run._run(devices[:4], {"data": 2, "pipe": 2},
+                      pp_schedule="1f1b", **kw)
+        np.testing.assert_allclose(pp["global_train_losses"],
+                                   dense["global_train_losses"], rtol=2e-3)
 
     def test_residuals_flat_in_microbatch_count(self, pipe_mesh):
         """vjp-closure-leaf comparison (the --pp_remat test's method):
