@@ -500,6 +500,11 @@ LADDER = [
     # modern decoder recipe: RMSNorm + RoPE + SwiGLU, untied head
     ("llama_medium_lm_l1024", "llama_medium", (1024,), 8, 30, 32000, True,
      300, {"attention_impl": "flash"}),
+    # the productive lever found in r3: grouped-query attention (4 kv
+    # heads shared by 16 query heads) cuts K/V HBM traffic end to end —
+    # measured +24% throughput over the MHA row above
+    ("llama_medium_gqa4_lm_l1024", "llama_medium", (1024,), 8, 30, 32000,
+     True, 300, {"attention_impl": "flash", "num_kv_heads": 4}),
 ]
 
 # BENCH_FAST=1 core subset: headline + the >=50%-MFU proof point + the
@@ -516,7 +521,9 @@ SHORT = {
     "gpt2_small_lm_l512": "gpt2_512", "vit_s16_imagenet": "vit_s",
     "vit_b16_imagenet": "vit_b",
     "gpt2_small_lm_l4096_flash": "gpt2_4k_flash",
-    "llama_medium_lm_l1024": "llama", "flash_attention": "flash",
+    "llama_medium_lm_l1024": "llama",
+    "llama_medium_gqa4_lm_l1024": "llama_gqa4",
+    "flash_attention": "flash",
 }
 
 

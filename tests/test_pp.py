@@ -277,6 +277,17 @@ class TestOneF1B:
         np.testing.assert_allclose(pp["global_train_losses"],
                                    dense["global_train_losses"], rtol=2e-3)
 
+    def test_driver_1f1b_llama(self, devices):
+        """Llama under 1f1b (RMSNorm head + untied lm_head, RoPE inside
+        the stages): trajectory must match the dense twin."""
+        run = TestDriverPipelineParallel()
+        kw = dict(model="llama_tiny", dataset="synthetic_lm")
+        dense = run._run(devices[:2], {"data": 2}, **kw)
+        pp = run._run(devices[:4], {"data": 2, "pipe": 2},
+                      pp_schedule="1f1b", **kw)
+        np.testing.assert_allclose(pp["global_train_losses"],
+                                   dense["global_train_losses"], rtol=2e-3)
+
     def test_residuals_flat_in_microbatch_count(self, pipe_mesh):
         """vjp-closure-leaf comparison (the --pp_remat test's method):
         GPipe-through-autodiff residuals grow with M (every schedule
