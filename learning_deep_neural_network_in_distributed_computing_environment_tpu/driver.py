@@ -151,17 +151,16 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             raise ValueError(
                 f"--pp_schedule 1f1b applies under pipeline parallelism "
                 f"(a '{PIPE_AXIS}' mesh axis of size >= 2)")
-        if (int(mesh.shape.get(MODEL_AXIS, 1)) > 1
-                or int(mesh.shape.get(EXPERT_AXIS, 1)) > 1
+        if (int(mesh.shape.get(EXPERT_AXIS, 1)) > 1
                 or cfg.num_experts > 0
                 or cfg.sequence_parallel != "none"
                 or not cfg.model.startswith(("bert", "gpt", "llama"))):
             raise NotImplementedError(
                 "--pp_schedule 1f1b currently supports bert_*/gpt_*/"
-                "llama_* under pure pipeline x data parallelism (the "
-                "per-microbatch head+loss runs inside the schedule; "
-                "vocab-parallel / MoE / sequence-parallel heads are "
-                "gpipe-only for now)")
+                "llama_* under pipeline x data x tensor parallelism "
+                "(the per-microbatch head+loss runs inside the schedule "
+                "— vocab-parallel under TP since r5; MoE / sequence-"
+                "parallel are gpipe-only for now)")
         from .mesh import FSDP_AXIS as _FS
         if int(mesh.shape.get(_FS, 1)) > 1:
             raise NotImplementedError(
@@ -292,14 +291,15 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         # and composes with tensor parallelism (2-D (fsdp, model) sharding:
         # ZeRO-3 claims a free dim of each TP-sharded leaf) and with
         # sequence parallelism (B over fsdp, L over seq).
-        if ep > 1 or cfg.num_experts > 0:
-            # MoE even without an expert axis: per-sub-batch routing would
-            # change capacity semantics and the psum over fsdp would scale
-            # the aux loss by the axis size (same reason as the MoE guard
-            # above)
-            raise NotImplementedError(
-                f"a '{FSDP_AXIS}' mesh axis does not yet compose with "
-                "expert parallelism or MoE")
+        # MoE x FSDP (r5): the worker batch splits over 'fsdp', so each
+        # slice routes its own tokens with per-slice capacity — the same
+        # semantics shift as per-microbatch routing under GPipe, and like
+        # that row it is golden-tested against the twin that SHARES the
+        # slicing (fsdp x ep == fsdp x unsharded-MoE exactly; EP shards
+        # only the expert stacks).  The aux-loss scaling is handled in the
+        # engine: the per-slice sown losses are averaged over 'fsdp'
+        # (train.py), so the gradient psum recovers full-batch scale
+        # instead of multiplying it by the axis size.
         if cfg.batch_size % fsdp:
             raise ValueError(
                 f"--batch_size {cfg.batch_size} must be divisible by the "

@@ -263,21 +263,24 @@ def onef1b_schedule(stage_fn: Callable, loss_fn: Callable, stage_params,
         gh=vary(_zeros_tree(head_params)),
         gxs=vary(jnp.zeros_like(xs)),
         loss=vary(jnp.zeros((), jnp.float32)),
-        aux=jax.tree_util.tree_map(
-            lambda sd: vary(jnp.zeros(sd.shape, sd.dtype)), aux_struct),
+        aux=vary(_zeros_tree(aux_struct)),
     )
 
     def tick(carry, t):
         fi, f_ok = _valid_fwd_index(t, s, p, m)
         bi, b_ok = _valid_bwd_index(t, s, p, m)
 
-        # Bubble slots are SKIPPED with lax.cond, not masked: stage_fn is
-        # collective-free under the 1f1b guards (no SP ring, no MoE
-        # psum), so per-device branch divergence is legal — the only
-        # cross-device sync points are the two ppermutes below, and the
-        # schedule stays lockstep on them.  This roughly halves the
-        # schedule's compute vs compute-then-mask (code-review r4: the
-        # head fwd+vjp alone otherwise runs 2M+2P-3 times for M seeds).
+        # Bubble slots are SKIPPED with lax.cond, not masked.  Legality:
+        # both predicates depend only on (pipe index s, tick t), so every
+        # device sharing a stage takes the SAME branch — Megatron psums
+        # over 'model' inside stage_fn / the vocab-parallel head (1F1B x
+        # TP, r5) are entered by whole model-groups or not at all, and
+        # the only cross-STAGE sync points are the two ppermutes below,
+        # which stay lockstep.  Guards still fence collectives whose
+        # groups span stages (SP ring) or whose semantics change under
+        # microbatching (MoE).  Skipping roughly halves the schedule's
+        # compute vs compute-then-mask (code-review r4: the head fwd+vjp
+        # alone otherwise runs 2M+2P-3 times for M seeds).
 
         # ---- fwd slot -------------------------------------------------
         # stage 0 injects xs[fi]; others consume the queue — depth 1 while
@@ -316,9 +319,7 @@ def onef1b_schedule(stage_fn: Callable, loss_fn: Callable, stage_params,
 
         def no_head(yy):
             return (vary(jnp.zeros((), jnp.float32)),
-                    jax.tree_util.tree_map(
-                        lambda sd: vary(jnp.zeros(sd.shape, sd.dtype)),
-                        aux_struct),
+                    vary(_zeros_tree(aux_struct)),
                     vary(_zeros_tree(head_params)),
                     vary(jnp.zeros_like(yy)))
 
@@ -376,8 +377,22 @@ def onef1b_schedule(stage_fn: Callable, loss_fn: Callable, stage_params,
 
 
 def _zeros_tree(tree):
-    return jax.tree_util.tree_map(
-        lambda l: jnp.zeros(l.shape, l.dtype), tree)
+    """Zeros matching each leaf's shape, dtype AND varying-axes set.
+
+    Under 1F1B x TP the stage/head gradient leaves are mesh-varying over
+    'model' as well as 'pipe'/'data'; a plain ``jnp.zeros`` is invariant
+    and would make the lax.cond branch avals (and scan carry types)
+    mismatch the real-gradient branch.  Preserving the SOURCE leaf's vma
+    here (the schedule's ``vary()`` then adds the pipe/data set on top)
+    keeps both branches type-identical for any sharding."""
+    def z(l):
+        zz = jnp.zeros(l.shape, l.dtype)
+        want = set(getattr(jax.typeof(l), "vma", None)
+                   or getattr(l, "vma", None) or ())
+        missing = tuple(sorted(
+            want - set(getattr(jax.typeof(zz), "vma", ()) or ())))
+        return lax.pcast(zz, missing, to="varying") if missing else zz
+    return jax.tree_util.tree_map(z, tree)
 
 
 def onef1b_loss(stage_fn: Callable, loss_fn: Callable, stage_params,
@@ -410,7 +425,11 @@ def onef1b_loss(stage_fn: Callable, loss_fn: Callable, stage_params,
         return scale(gs), scale(gh), scale(gxs)
 
     f.defvjp(fwd, bwd)
-    return f(stage_params, head_params, xs)
+    loss, aux = f(stage_params, head_params, xs)
+    # metrics-only contract made structural (advisor r4): without this a
+    # caller differentiating an aux metric would get silent zeros from the
+    # custom bwd's discarded cot[1]; stop_gradient declares it instead
+    return loss, jax.tree_util.tree_map(lax.stop_gradient, aux)
 
 
 def pp_param_specs(params, axis: str = "pipe"):

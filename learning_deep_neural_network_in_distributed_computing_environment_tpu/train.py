@@ -144,6 +144,11 @@ def softmax_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray):
 
 
 def _ce_fwd(logits, labels):
+    # clamp ONCE here and store the clamped labels in the residual, so the
+    # forward's take_along_axis and the backward's onehot agree for any
+    # input (advisor r4: a negative label used to wrap in fwd but match
+    # nothing in bwd).  Callers wanting ignore-index mask separately.
+    labels = jnp.clip(labels, 0, logits.shape[-1] - 1)
     lf = logits.astype(jnp.float32)
     m = jnp.max(lf, axis=-1, keepdims=True)
     lse = jnp.log(jnp.sum(jnp.exp(lf - m), axis=-1, keepdims=True)) + m
@@ -422,6 +427,7 @@ class LocalSGDEngine:
         emb = tm.apply({"params": params}, xb, train=True, mode="embed")
         xs = emb.reshape(mnum, b // mnum, *emb.shape[1:])
         ys = yb.reshape(mnum, b // mnum, *yb.shape[1:])
+        mbs = mb.reshape(mnum, b // mnum, *mb.shape[1:])
         w = mb.reshape(mb.shape + (1,) * (yb.ndim - mb.ndim))
         w = jnp.broadcast_to(w, yb.shape).astype(jnp.float32) * (yb >= 0)
         ws = w.reshape(mnum, b // mnum, *w.shape[1:])
@@ -435,6 +441,16 @@ class LocalSGDEngine:
 
         def loss_fn(hp, y, i):
             logits = tm.apply({"params": hp}, y, train=True, mode="head")
+            if self.vp_axis is not None:
+                # 1F1B x TP (r5): the head emitted its LOCAL vocab slice;
+                # the Megatron vocab-parallel CE psums over 'model' inside
+                # the schedule — legal because the schedule's cond
+                # predicates are uniform across each model-group
+                # (parallel/pp.py tick)
+                from .parallel.tp import vocab_parallel_token_stats
+                ce, w_i, correct_i = vocab_parallel_token_stats(
+                    logits, ys[i], mbs[i], self.vp_axis)
+                return (ce * w_i).sum() / denom, (correct_i, w_i.sum())
             ce = softmax_cross_entropy(logits, jnp.maximum(ys[i], 0))
             w_i = ws[i]
             loss_i = (ce * w_i).sum() / denom
@@ -499,6 +515,12 @@ class LocalSGDEngine:
             a = sum(jnp.sum(x) for x in aux)
             if self.pipe_axis is not None:
                 a = lax.psum(a, self.pipe_axis)
+            if self.fsdp_axis is not None:
+                # each fsdp slice routed its own sub-batch and sowed its
+                # own load-balance loss; average so the cross-device
+                # gradient reduction recovers full-batch aux scale rather
+                # than multiplying it by the axis size (r5 FSDP x MoE)
+                a = a / lax.axis_size(self.fsdp_axis)
             loss = loss + self.cfg.moe_aux_weight * a
         new_bs = mut.get("batch_stats", batch_stats)
         if self.fsdp_axis and jax.tree_util.tree_leaves(new_bs):

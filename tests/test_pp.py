@@ -288,6 +288,37 @@ class TestOneF1B:
         np.testing.assert_allclose(pp["global_train_losses"],
                                    dense["global_train_losses"], rtol=2e-3)
 
+    def test_driver_1f1b_tp_matches_gpipe_and_dense(self, devices):
+        """1F1B x TP (r5): GPT's tied vocab-parallel head runs INSIDE the
+        schedule (masked-psum lookup outside, local-slice CE within each
+        microbatch's head slot).  The strongest check compares the FINAL
+        PARAMETERS — not just the loss trajectory — against the GPipe
+        pp x tp run on the identical mesh/seed: both must produce the
+        same gradients, so after identical Adam updates the weights must
+        agree to float tolerance.  Trajectory must also match dense."""
+        run = TestDriverPipelineParallel()
+        kw = dict(model="gpt_tiny", dataset="synthetic_lm")
+        dense = run._run(devices[:2], {"data": 2}, **kw)
+        mesh3d = {"data": 2, "pipe": 2, "model": 2}
+        gpipe = run._run(devices, mesh3d, **kw)
+        onef = run._run(devices, mesh3d, pp_schedule="1f1b", **kw)
+        np.testing.assert_allclose(onef["global_train_losses"],
+                                   dense["global_train_losses"], rtol=2e-3)
+        for a, b in zip(jax.tree_util.tree_leaves(onef["state"].params),
+                        jax.tree_util.tree_leaves(gpipe["state"].params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-5)
+
+    def test_driver_1f1b_tp_bert_untied_head(self, devices):
+        """1F1B x TP with BERT's UNTIED vocab-parallel MLM decode (the
+        other head construction): trajectory matches the dense twin."""
+        run = TestDriverPipelineParallel()
+        dense = run._run(devices[:2], {"data": 2})
+        pp = run._run(devices, {"data": 2, "pipe": 2, "model": 2},
+                      pp_schedule="1f1b", pp_microbatches=4)
+        np.testing.assert_allclose(pp["global_train_losses"],
+                                   dense["global_train_losses"], rtol=2e-3)
+
     def test_residuals_flat_in_microbatch_count(self, pipe_mesh):
         """vjp-closure-leaf comparison (the --pp_remat test's method):
         GPipe-through-autodiff residuals grow with M (every schedule
