@@ -28,6 +28,32 @@ import jax.numpy as jnp
 from .bert import EncoderLayer, _ScanLayer, _init
 
 
+class _PatchEmbed(nn.Module):
+    """Patch embedding as a single einsum over the 6-D patch view.
+
+    Parameter-compatible with ``nn.Dense(hidden, name="patch_embed")``
+    (same ``kernel`` [p*p*c, H] / ``bias`` [H] leaves): the kernel is
+    viewed as [p, p, c, H] at apply time and contracted directly against
+    ``x.reshape(b, h/p, p, w/p, p, c)`` — no explicit 6-D transpose for
+    XLA to materialize in either the forward or its backward."""
+
+    features: int
+    patch: int
+    channels: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x6):
+        kernel = self.param("kernel", _init,
+                            (self.patch * self.patch * self.channels,
+                             self.features))
+        bias = self.param("bias", nn.initializers.zeros, (self.features,))
+        k4 = kernel.reshape(self.patch, self.patch, self.channels,
+                            self.features).astype(self.dtype)
+        y = jnp.einsum("bipjqc,pqch->bijh", x6, k4)
+        return y + bias.astype(self.dtype)
+
+
 class ViT(nn.Module):
     """Images [B, H, W, C] -> class logits [B, num_classes]."""
 
@@ -50,6 +76,14 @@ class ViT(nn.Module):
     expert_axis: Optional[str] = None
     ep_size: int = 1
     capacity_factor: float = 1.25
+    # patchify lowering (r5, VERDICT r4 'next' #3 — the trace's 22%
+    # "output/data-fmt" category): 'einsum' contracts the 6-D patch view
+    # against the [p, p, c, H] view of the SAME [p*p*c, H] kernel, letting
+    # XLA fold the patch relayout into the matmul's operand load instead
+    # of being handed an explicit 6-D transpose whose backward is another
+    # full relayout.  'reshape' keeps the r4 lowering (A/B twin).  The
+    # parameter structure is identical either way.
+    patchify: str = "einsum"
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
@@ -58,12 +92,17 @@ class ViT(nn.Module):
         if h % p or w % p:
             raise ValueError(f"input {h}x{w} not divisible by patch {p}")
         x = jnp.asarray(x, self.dtype)
-        # non-overlapping patchify as reshape + matmul (see module docstring)
-        x = x.reshape(b, h // p, p, w // p, p, c)
-        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(
-            b, (h // p) * (w // p), p * p * c)
-        x = nn.Dense(self.hidden, kernel_init=_init, dtype=self.dtype,
-                     name="patch_embed")(x)
+        n = (h // p) * (w // p)
+        if self.patchify == "einsum":
+            x6 = x.reshape(b, h // p, p, w // p, p, c)
+            x = _PatchEmbed(self.hidden, p, c, dtype=self.dtype,
+                            name="patch_embed")(x6).reshape(b, n, self.hidden)
+        else:
+            # non-overlapping patchify as reshape + matmul (module docstring)
+            x = x.reshape(b, h // p, p, w // p, p, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, n, p * p * c)
+            x = nn.Dense(self.hidden, kernel_init=_init, dtype=self.dtype,
+                         name="patch_embed")(x)
         pos = self.param("pos_emb", _init, (1, x.shape[1], self.hidden))
         x = x + pos.astype(x.dtype)
         if self.scan_layers:

@@ -52,6 +52,27 @@ class TestViTModule:
         np.testing.assert_allclose(
             tokens, conv_out.reshape(2, 16, hdim), rtol=2e-5, atol=1e-5)
 
+    def test_patchify_einsum_equals_reshape(self):
+        """The r5 default 'einsum' patchify (no explicit 6-D transpose;
+        VERDICT r4 'next' #3) computes EXACTLY the same function as the
+        r4 'reshape' lowering, with an identical parameter tree — one
+        init serves both variants."""
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.models.vit import ViT
+        kw = dict(num_classes=10, patch=8, num_layers=2, hidden=64,
+                  num_heads=2, ffn_dim=128)
+        ein = ViT(**kw)                      # patchify='einsum' (default)
+        ref = ViT(**kw, patchify="reshape")
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.normal(size=(2, 32, 32, 3)), jnp.float32)
+        variables = ein.init(jax.random.key(2), x, train=False)
+        assert (jax.tree_util.tree_structure(variables)
+                == jax.tree_util.tree_structure(
+                    ref.init(jax.random.key(2), x, train=False)))
+        np.testing.assert_allclose(
+            np.asarray(ein.apply(variables, x, train=False)),
+            np.asarray(ref.apply(variables, x, train=False)),
+            rtol=1e-5, atol=1e-5)
+
     def test_param_count_vit_s16(self):
         """ViT-S/16 at 224^2/1000 classes: ~22M params (sanity that the
         geometry matches the standard family)."""

@@ -32,6 +32,14 @@ CONFIGS = {
                     num_classes=1000, token=False),
     "bert_base": dict(name="bert_base", shape=(128,), batch=64,
                       num_classes=30522, token=True),
+    # the bench's gpt2_4k_flash row (VERDICT r4 'next' #1: the one ladder
+    # entry at ~half its own roofline, and the one workload the profiler
+    # couldn't see — its time lives inside Pallas custom calls where
+    # XLA's cost model reports neither flops nor bytes)
+    "gpt2_4k_flash": dict(name="gpt2_small", shape=(4096,), batch=2,
+                          num_classes=50257, token=True,
+                          model_kw=dict(attention_impl="flash",
+                                        max_len=4096)),
 }
 
 
@@ -47,7 +55,7 @@ def build_step(cfg):
     from learning_deep_neural_network_in_distributed_computing_environment_tpu.train import softmax_cross_entropy
 
     model = get_model(cfg["name"], num_classes=cfg["num_classes"],
-                      dtype=jnp.bfloat16)
+                      dtype=jnp.bfloat16, **cfg.get("model_kw", {}))
     rng = np.random.default_rng(0)
     if cfg["token"]:
         x = jnp.asarray(rng.integers(2, cfg["num_classes"],
@@ -115,6 +123,7 @@ def parse_trace(trace_dir: str) -> dict | None:
                and "args" in e and e["args"].get("name") == "XLA Ops"
                and e["pid"] in dev_pids}
     cats: dict[str, dict] = {}
+    ops: dict[str, float] = {}
     for e in events:
         if e.get("ph") != "X" or "dur" not in e:
             continue
@@ -126,11 +135,18 @@ def parse_trace(trace_dir: str) -> dict | None:
         c["us"] += e["dur"]
         c["flops"] += float(args.get("model_flops", 0) or 0)
         c["bytes"] += float(args.get("bytes_accessed", 0) or 0)
+        # per-op-name rollup: custom calls (Pallas kernels) all land in
+        # one category with zero cost-model flops/bytes — the NAME is the
+        # only way to attribute which kernel eats the time
+        ops[e.get("name", "?")] = ops.get(e.get("name", "?"), 0.0) + e["dur"]
     total = sum(c["us"] for c in cats.values())
     if not total:
         return None
-    return {"total_us": total, "by_category": dict(sorted(
-        cats.items(), key=lambda kv: -kv[1]["us"]))}
+    return {"total_us": total,
+            "by_category": dict(sorted(
+                cats.items(), key=lambda kv: -kv[1]["us"])),
+            "top_ops": dict(sorted(ops.items(),
+                                   key=lambda kv: -kv[1])[:14])}
 
 
 def main() -> None:
@@ -175,6 +191,12 @@ def main() -> None:
                   f"{100 * c['us'] / tot:5.1f}% "
                   f"{c['flops'] / sec / 1e12:7.1f} "
                   f"{c['bytes'] / sec / 1e9:7.1f}")
+        print("  top ops by device time:")
+        for name, us in parsed["top_ops"].items():
+            if us / tot < 0.01:
+                continue
+            print(f"    {name[:58]:58s} {us / 1e3:7.2f}ms "
+                  f"{100 * us / tot:5.1f}%")
 
 
 if __name__ == "__main__":
