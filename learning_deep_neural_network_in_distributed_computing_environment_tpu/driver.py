@@ -221,11 +221,13 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             raise ValueError(
                 f"--num_experts applies to attention models (bert_*/gpt_*/vit_*/llama_*); "
                 f"got --model {cfg.model}")
-        if cfg.sequence_parallel != "none":
-            raise NotImplementedError(
-                "MoE does not yet compose with sequence parallelism "
-                "(per-seq-chunk routing would change the capacity and "
-                "aux-loss semantics)")
+        # MoE x SP (r5): each seq-parallel device routes its own chunk of
+        # every sequence with per-chunk capacity — the same declared
+        # semantics shift as FSDP x MoE above, golden-tested the same
+        # way (MoE x SP x EP == MoE x SP exactly; EP shards only the
+        # expert stacks).  The engine averages the per-chunk aux losses
+        # over every batch-partial axis (train.py) so the seq-axis grad
+        # psum recovers full-batch aux scale.
         base_kw.update(num_experts=cfg.num_experts,
                        capacity_factor=cfg.expert_capacity_factor)
         if ep > 1:

@@ -515,12 +515,17 @@ class LocalSGDEngine:
             a = sum(jnp.sum(x) for x in aux)
             if self.pipe_axis is not None:
                 a = lax.psum(a, self.pipe_axis)
-            if self.fsdp_axis is not None:
-                # each fsdp slice routed its own sub-batch and sowed its
-                # own load-balance loss; average so the cross-device
-                # gradient reduction recovers full-batch aux scale rather
-                # than multiplying it by the axis size (r5 FSDP x MoE)
-                a = a / lax.axis_size(self.fsdp_axis)
+            part_aux = self._part_axes()
+            if part_aux:
+                # each fsdp slice / seq chunk routed its own tokens and
+                # sowed its own load-balance loss; average so the cross-
+                # device gradient reduction recovers full-batch aux scale
+                # rather than multiplying it by the axis sizes (r5
+                # FSDP x MoE, MoE x SP)
+                denom_aux = 1.0
+                for ax in part_aux:
+                    denom_aux = denom_aux * lax.axis_size(ax)
+                a = a / denom_aux
             loss = loss + self.cfg.moe_aux_weight * a
         new_bs = mut.get("batch_stats", batch_stats)
         if self.fsdp_axis and jax.tree_util.tree_leaves(new_bs):
