@@ -142,6 +142,13 @@ def _scan_rate(scank, state, k: int, samples: int = 3):
         t = max(time.perf_counter() - t0 - _FETCH_OVERHEAD, 1e-9)
         return k / t, 1.0, True, state
     good.sort()
+    if len(good) >= 6:
+        # the retry path ran (some sample disagreed > 30%): trim the two
+        # extremes before the median/spread so ONE transient relay slow
+        # window cannot dominate the reported pm no matter how many
+        # clean samples surround it (r5 rehearsal: bert pm 37 MFU points
+        # from a single outlier among 7)
+        good = good[1:-1]
     med = good[len(good) // 2]
     spread = (good[-1] - good[0]) / (2 * med)
     # state rides along: scank donates its argument, so the caller's old

@@ -157,15 +157,16 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                 or not cfg.model.startswith(("bert", "gpt", "llama"))):
             raise NotImplementedError(
                 "--pp_schedule 1f1b currently supports bert_*/gpt_*/"
-                "llama_* under pipeline x data x tensor parallelism "
-                "(the per-microbatch head+loss runs inside the schedule "
-                "— vocab-parallel under TP since r5; MoE / sequence-"
-                "parallel are gpipe-only for now)")
-        from .mesh import FSDP_AXIS as _FS
-        if int(mesh.shape.get(_FS, 1)) > 1:
-            raise NotImplementedError(
-                "--pp_schedule 1f1b does not yet compose with FSDP "
-                "(the schedule gathers no fsdp shards)")
+                "llama_* under pipeline x data x tensor x fsdp "
+                "parallelism (per-microbatch head+loss inside the "
+                "schedule, vocab-parallel under TP, ZeRO-3 gather "
+                "outside the schedule — r5; MoE / sequence-parallel "
+                "are gpipe-only for now)")
+        # 1F1B x FSDP (r5): the ZeRO-3 shards gather OUTSIDE the
+        # custom-VJP schedule (train.py _onef1b_loss_and_metrics), so
+        # the schedule runs on full params and the reduce-scatter is the
+        # gather's transpose downstream of the schedule's full grads —
+        # no guard needed.
     if pp > 1:
         # pipeline parallelism (GPipe schedule, parallel/pp.py): the
         # stacked layer axis shards over 'pipe'; the dense twin must use

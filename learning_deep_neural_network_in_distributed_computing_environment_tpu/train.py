@@ -424,14 +424,36 @@ class LocalSGDEngine:
         tm = self.train_model
         mnum = tm.num_microbatches or tm.pp_size
         b = xb.shape[0]
+        if self.fsdp_axis:
+            # 1F1B x FSDP (r5): ZeRO-3 shards gather to full params HERE,
+            # OUTSIDE the custom-VJP schedule — the schedule then runs on
+            # full params with no fsdp collectives inside any tick, and
+            # the gradient reduce-scatter is the gather's transpose in
+            # the OUTER vjp, downstream of onef1b_loss's returned full
+            # grads.  The fsdp axis splits the worker batch, so the
+            # masked-mean denominator psums over it (grads then sum to
+            # the full-batch gradient exactly, as in the standard path).
+            from .parallel.fsdp import gather_params
+            params = gather_params(params, self.param_specs,
+                                   self.fsdp_axis)
         emb = tm.apply({"params": params}, xb, train=True, mode="embed")
-        xs = emb.reshape(mnum, b // mnum, *emb.shape[1:])
         ys = yb.reshape(mnum, b // mnum, *yb.shape[1:])
         mbs = mb.reshape(mnum, b // mnum, *mb.shape[1:])
         w = mb.reshape(mb.shape + (1,) * (yb.ndim - mb.ndim))
         w = jnp.broadcast_to(w, yb.shape).astype(jnp.float32) * (yb >= 0)
         ws = w.reshape(mnum, b // mnum, *w.shape[1:])
-        denom = jnp.maximum(w.sum(), 1.0)  # data-derived: known pre-schedule
+        denom = w.sum()
+        if self.fsdp_axis:
+            denom = lax.psum(denom, self.fsdp_axis)
+            # ORDER this mask-only psum BEFORE the schedule's pipe
+            # ppermutes on every device: it is otherwise DAG-independent
+            # of them, and intersecting-group collectives entered in
+            # different per-device orders deadlock the unpinned XLA:CPU
+            # rendezvous (the same race the standard path barriers at
+            # its metrics psum; free on TPU)
+            emb = lax.optimization_barrier((emb, denom))[0]
+        xs = emb.reshape(mnum, b // mnum, *emb.shape[1:])
+        denom = jnp.maximum(denom, 1.0)  # data-derived: known pre-schedule
         stage_params = params["layers"]
         head_params = {k: v for k, v in params.items() if k != "layers"}
 
@@ -460,6 +482,10 @@ class LocalSGDEngine:
         loss, (correct, total) = onef1b_loss(
             stage_fn, loss_fn, stage_params, head_params, xs,
             axis_name=self.pipe_axis, num_micro=mnum)
+        if self.fsdp_axis:
+            # schedule aux counted this device's fsdp slice only
+            correct = lax.psum(correct, self.fsdp_axis)
+            total = lax.psum(total, self.fsdp_axis)
         return loss, (batch_stats, correct, total)
 
     def _loss_and_metrics(self, params, batch_stats, xb, yb, mb):
