@@ -153,15 +153,24 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                 f"(a '{PIPE_AXIS}' mesh axis of size >= 2)")
         if (int(mesh.shape.get(EXPERT_AXIS, 1)) > 1
                 or cfg.num_experts > 0
-                or cfg.sequence_parallel != "none"
                 or not cfg.model.startswith(("bert", "gpt", "llama"))):
             raise NotImplementedError(
                 "--pp_schedule 1f1b currently supports bert_*/gpt_*/"
-                "llama_* under pipeline x data x tensor x fsdp "
+                "llama_* under pipeline x data x tensor x seq x fsdp "
                 "parallelism (per-microbatch head+loss inside the "
-                "schedule, vocab-parallel under TP, ZeRO-3 gather "
-                "outside the schedule — r5; MoE / sequence-parallel "
-                "are gpipe-only for now)")
+                "schedule — vocab-parallel under TP, chunk-local under "
+                "SP, ZeRO-3 gather outside the schedule; MoE is "
+                "gpipe-only: its sown aux losses would be silently "
+                "dropped by the schedule's stage apply)")
+        # 1F1B x SP (r5): the schedule runs its fwd/bwd slots in
+        # GPipe-style MASKED mode under SP (train.py passes
+        # masked_slots) — a ppermute inside a pipe-varying lax.cond
+        # miscomputes (parallel/pp.py r5 note; psum is exact, ppermute
+        # is not), so the ring collectives must execute unconditionally.
+        # The head slot needs no collective at all (local numerator over
+        # the pre-psum'd global denominator, as in the standard SP
+        # path).  The unpinned-CPU fail-fast below covers the rendezvous
+        # race for any SP x PP combination, 1f1b included.
         # 1F1B x FSDP (r5): the ZeRO-3 shards gather OUTSIDE the
         # custom-VJP schedule (train.py _onef1b_loss_and_metrics), so
         # the schedule runs on full params and the reduce-scatter is the

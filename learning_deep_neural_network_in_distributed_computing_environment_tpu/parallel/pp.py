@@ -202,7 +202,7 @@ def _valid_bwd_index(t, s, p, m):
 
 def onef1b_schedule(stage_fn: Callable, loss_fn: Callable, stage_params,
                     head_params, xs: jnp.ndarray, axis_name: str,
-                    num_micro: int):
+                    num_micro: int, masked_slots: bool = False):
     """Run the 1F1B pipeline schedule, computing loss AND gradients.
 
     ``stage_fn(stage_params, x)``: this stage's layer block (same
@@ -270,17 +270,30 @@ def onef1b_schedule(stage_fn: Callable, loss_fn: Callable, stage_params,
         fi, f_ok = _valid_fwd_index(t, s, p, m)
         bi, b_ok = _valid_bwd_index(t, s, p, m)
 
-        # Bubble slots are SKIPPED with lax.cond, not masked.  Legality:
-        # both predicates depend only on (pipe index s, tick t), so every
-        # device sharing a stage takes the SAME branch — Megatron psums
-        # over 'model' inside stage_fn / the vocab-parallel head (1F1B x
-        # TP, r5) are entered by whole model-groups or not at all, and
-        # the only cross-STAGE sync points are the two ppermutes below,
-        # which stay lockstep.  Guards still fence collectives whose
-        # groups span stages (SP ring) or whose semantics change under
-        # microbatching (MoE).  Skipping roughly halves the schedule's
-        # compute vs compute-then-mask (code-review r4: the head fwd+vjp
-        # alone otherwise runs 2M+2P-3 times for M seeds).
+        # Bubble slots are SKIPPED with lax.cond by default, not masked.
+        # Legality: both predicates depend only on (pipe index s, tick
+        # t), so every device sharing a stage takes the SAME branch —
+        # Megatron psums over 'model' inside stage_fn / the vocab-
+        # parallel head (1F1B x TP, r5) are entered by whole
+        # model-groups or not at all, and the only cross-STAGE sync
+        # points are the two ppermutes below, which stay lockstep.
+        # Skipping roughly halves the schedule's compute vs compute-
+        # then-mask (code-review r4: the head fwd+vjp alone otherwise
+        # runs 2M+2P-3 times for M seeds).
+        #
+        # EXCEPTION (r5, found by unit bisect): a ``ppermute`` inside a
+        # cond whose predicate varies over 'pipe' computes WRONG VALUES
+        # (psum and all_gather in the same position are exact — the
+        # rewrite of ppermute's paired sends under a varying-predicate
+        # cond is what breaks).  ``masked_slots=True`` therefore runs
+        # the FWD and BWD slots unconditionally and masks the results —
+        # GPipe's proven semantics, exact by construction for ANY
+        # collective — and the engine selects it whenever stage_fn
+        # carries ring/Ulysses sequence-parallel attention.  The head
+        # slot keeps its cond in either mode (no seq collective there).
+        def mask_tree(ok, tree):
+            return jax.tree_util.tree_map(
+                lambda l: jnp.where(ok, l, jnp.zeros_like(l)), tree)
 
         # ---- fwd slot -------------------------------------------------
         # stage 0 injects xs[fi]; others consume the queue — depth 1 while
@@ -289,8 +302,11 @@ def onef1b_schedule(stage_fn: Callable, loss_fn: Callable, stage_params,
         x_in = jnp.where(s == 0, x_own,
                          jnp.where(fi <= p - 1 - s, carry["q1"],
                                    carry["q2"]))
-        y = lax.cond(f_ok, lambda x: vary(stage_fn(stage_params, x)),
-                     lambda x: vary(jnp.zeros_like(x)), x_in)
+        if masked_slots:
+            y = mask_tree(f_ok, vary(stage_fn(stage_params, x_in)))
+        else:
+            y = lax.cond(f_ok, lambda x: vary(stage_fn(stage_params, x)),
+                         lambda x: vary(jnp.zeros_like(x)), x_in)
         res = jnp.where(f_ok, carry["res"].at[fi % nres].set(x_in),
                         carry["res"])
 
@@ -323,6 +339,12 @@ def onef1b_schedule(stage_fn: Callable, loss_fn: Callable, stage_params,
                     vary(_zeros_tree(head_params)),
                     vary(jnp.zeros_like(yy)))
 
+        # the head slot keeps the cond skip even under masked_slots: it
+        # contains no sequence-parallel collective (chunk-local CE; the
+        # vocab-parallel psum is over 'model', cond-proven under the
+        # uniform model-group predicate), and masking it would run the
+        # dominant [hidden, vocab] fwd+vjp 2M+2P-3 times per step
+        # instead of M on the last stage (code-review r5)
         l_val, aux_i, dh_i, dy_i = lax.cond(seed_ok, do_head, no_head, y)
         loss = carry["loss"] + l_val
         aux = jax.tree_util.tree_map(lambda a, v: a + v, carry["aux"],
@@ -351,7 +373,11 @@ def onef1b_schedule(stage_fn: Callable, loss_fn: Callable, stage_params,
             return (vary(_zeros_tree(stage_params)),
                     vary(jnp.zeros_like(x_res)))
 
-        ds_i, dx_i = lax.cond(b_ok, do_bwd, no_bwd, (g_in, x_res))
+        if masked_slots:
+            ds_i, dx_i = (mask_tree(b_ok, t)
+                          for t in do_bwd((g_in, x_res)))
+        else:
+            ds_i, dx_i = lax.cond(b_ok, do_bwd, no_bwd, (g_in, x_res))
         gs = jax.tree_util.tree_map(lambda a, d: a + d, carry["gs"], ds_i)
         gxs = jnp.where(b_ok & (s == 0),
                         carry["gxs"].at[bi].add(dx_i), carry["gxs"])
@@ -397,7 +423,7 @@ def _zeros_tree(tree):
 
 def onef1b_loss(stage_fn: Callable, loss_fn: Callable, stage_params,
                 head_params, xs: jnp.ndarray, *, axis_name: str,
-                num_micro: int):
+                num_micro: int, masked_slots: bool = False):
     """Differentiable entry point: ``(loss, aux) = onef1b_loss(...)``
     behaves like a plain function of (stage_params, head_params, xs)
     under ``jax.grad`` / ``value_and_grad`` (differentiate the loss;
@@ -409,12 +435,14 @@ def onef1b_loss(stage_fn: Callable, loss_fn: Callable, stage_params,
     @jax.custom_vjp
     def f(sp, hp, x):
         out = onef1b_schedule(stage_fn, loss_fn, sp, hp, x,
-                              axis_name, num_micro)
+                              axis_name, num_micro,
+                              masked_slots=masked_slots)
         return out[0], out[1]
 
     def fwd(sp, hp, x):
         loss, aux, gs, gh, gxs = onef1b_schedule(
-            stage_fn, loss_fn, sp, hp, x, axis_name, num_micro)
+            stage_fn, loss_fn, sp, hp, x, axis_name, num_micro,
+            masked_slots=masked_slots)
         return (loss, aux), (gs, gh, gxs)
 
     def bwd(resid, cot):

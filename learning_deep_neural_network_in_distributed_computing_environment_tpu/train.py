@@ -443,8 +443,17 @@ class LocalSGDEngine:
         w = jnp.broadcast_to(w, yb.shape).astype(jnp.float32) * (yb >= 0)
         ws = w.reshape(mnum, b // mnum, *w.shape[1:])
         denom = w.sum()
-        if self.fsdp_axis:
-            denom = lax.psum(denom, self.fsdp_axis)
+        part = self._part_axes()
+        if part:
+            # the batch is PARTIAL on this device (fsdp slice of the
+            # worker batch and/or one seq chunk of every sequence): the
+            # masked-mean denominator is global, while each loss_fn
+            # below returns its LOCAL numerator over it — the 1F1B twin
+            # of the standard path's construction, so the cross-device
+            # gradient reduction (train_step's psum over seq /
+            # reduce-scatter over fsdp) sums to grad(global loss) with
+            # NO collective inside the schedule's head slot.
+            denom = lax.psum(denom, part)
             # ORDER this mask-only psum BEFORE the schedule's pipe
             # ppermutes on every device: it is otherwise DAG-independent
             # of them, and intersecting-group collectives entered in
@@ -481,11 +490,16 @@ class LocalSGDEngine:
 
         loss, (correct, total) = onef1b_loss(
             stage_fn, loss_fn, stage_params, head_params, xs,
-            axis_name=self.pipe_axis, num_micro=mnum)
-        if self.fsdp_axis:
-            # schedule aux counted this device's fsdp slice only
-            correct = lax.psum(correct, self.fsdp_axis)
-            total = lax.psum(total, self.fsdp_axis)
+            axis_name=self.pipe_axis, num_micro=mnum,
+            # ring/Ulysses attention puts ppermutes/all-to-alls inside
+            # the slots; a ppermute under a pipe-varying cond predicate
+            # miscomputes (parallel/pp.py r5 note), so SP runs the
+            # schedule with GPipe-style masked slots instead of skips
+            masked_slots=self.seq_axis is not None)
+        if part:
+            # schedule aux counted this device's batch slice / seq chunk
+            correct = lax.psum(correct, part)
+            total = lax.psum(total, part)
         return loss, (batch_stats, correct, total)
 
     def _loss_and_metrics(self, params, batch_stats, xb, yb, mb):
