@@ -202,7 +202,8 @@ def _valid_bwd_index(t, s, p, m):
 
 def onef1b_schedule(stage_fn: Callable, loss_fn: Callable, stage_params,
                     head_params, xs: jnp.ndarray, axis_name: str,
-                    num_micro: int, masked_slots: bool = False):
+                    num_micro: int, masked_slots: bool = False,
+                    stage_aux_weight: float | None = None):
     """Run the 1F1B pipeline schedule, computing loss AND gradients.
 
     ``stage_fn(stage_params, x)``: this stage's layer block (same
@@ -215,6 +216,15 @@ def onef1b_schedule(stage_fn: Callable, loss_fn: Callable, stage_params,
     across microbatches and NOT differentiated.
     ``xs`` [M, mb, ...]: microbatched schedule inputs (post-embedding).
 
+    ``stage_aux_weight`` (1F1B x MoE, r5): when not None, ``stage_fn``
+    returns ``(y, aux)`` where ``aux`` is this stage's scalar
+    load-balance-loss sum for the microbatch (already at per-microbatch
+    scale); the schedule adds ``weight * aux`` to the loss at every
+    valid fwd slot and seeds the bwd slot's vjp with the matching
+    ``weight`` cotangent on the aux output — so the auxiliary loss is
+    differentiated through the stage exactly, preserving the custom-VJP
+    linearity in the upstream scalar.
+
     Returns ``(loss, aux, gs, gh, gxs)``: scalar loss, summed aux, and
     the gradients w.r.t. stage_params / head_params / xs, all replicated
     along ``axis_name``.  Every tick recomputes the bwd slot's stage
@@ -223,6 +233,7 @@ def onef1b_schedule(stage_fn: Callable, loss_fn: Callable, stage_params,
     p = lax.axis_size(axis_name)
     s = lax.axis_index(axis_name)
     m = num_micro
+    has_aux = stage_aux_weight is not None
     # last bwd lands on stage 0 at tick 2(m-1) + 2(p-1)
     ticks = 2 * m + 2 * p - 3
 
@@ -302,11 +313,19 @@ def onef1b_schedule(stage_fn: Callable, loss_fn: Callable, stage_params,
         x_in = jnp.where(s == 0, x_own,
                          jnp.where(fi <= p - 1 - s, carry["q1"],
                                    carry["q2"]))
+
+        def run_stage(x):
+            out = stage_fn(stage_params, x)
+            y, a = out if has_aux else (out, jnp.zeros((), jnp.float32))
+            return vary(y), vary(a.astype(jnp.float32))
+
         if masked_slots:
-            y = mask_tree(f_ok, vary(stage_fn(stage_params, x_in)))
+            y, a_i = mask_tree(f_ok, run_stage(x_in))
         else:
-            y = lax.cond(f_ok, lambda x: vary(stage_fn(stage_params, x)),
-                         lambda x: vary(jnp.zeros_like(x)), x_in)
+            y, a_i = lax.cond(
+                f_ok, run_stage,
+                lambda x: (vary(jnp.zeros_like(x)),
+                           vary(jnp.zeros((), jnp.float32))), x_in)
         res = jnp.where(f_ok, carry["res"].at[fi % nres].set(x_in),
                         carry["res"])
 
@@ -347,6 +366,10 @@ def onef1b_schedule(stage_fn: Callable, loss_fn: Callable, stage_params,
         # instead of M on the last stage (code-review r5)
         l_val, aux_i, dh_i, dy_i = lax.cond(seed_ok, do_head, no_head, y)
         loss = carry["loss"] + l_val
+        if has_aux:
+            # this stage's MoE load-balance contribution for the valid
+            # fwd slot; summed across stages by the final pipe psum
+            loss = loss + stage_aux_weight * a_i
         aux = jax.tree_util.tree_map(lambda a, v: a + v, carry["aux"],
                                      aux_i)
         gh = jax.tree_util.tree_map(lambda a, d: a + d, carry["gh"], dh_i)
@@ -364,9 +387,19 @@ def onef1b_schedule(stage_fn: Callable, loss_fn: Callable, stage_params,
         def do_bwd(args):
             g, x = args
             # recompute this stage's forward from the stored input
-            # (remat) and pull the cotangent back through it
-            ds, dx = jax.vjp(stage_fn, stage_params, x)[1](
-                g.astype(x.dtype))
+            # (remat) and pull the cotangent back through it; with MoE
+            # the aux output's cotangent IS the aux weight (the loss is
+            # linear in it), so the load-balance gradient flows through
+            # the same vjp
+            if has_aux:
+                (_, a_p), pull = jax.vjp(stage_fn, stage_params, x)
+                # a_p * 0 + w: a weight-valued cotangent inheriting the
+                # aux primal's dtype AND varying-axes set exactly
+                ds, dx = pull((g.astype(x.dtype),
+                               a_p * 0 + stage_aux_weight))
+            else:
+                ds, dx = jax.vjp(stage_fn, stage_params, x)[1](
+                    g.astype(x.dtype))
             return vary(ds), vary(dx)
 
         def no_bwd(args):
@@ -423,7 +456,8 @@ def _zeros_tree(tree):
 
 def onef1b_loss(stage_fn: Callable, loss_fn: Callable, stage_params,
                 head_params, xs: jnp.ndarray, *, axis_name: str,
-                num_micro: int, masked_slots: bool = False):
+                num_micro: int, masked_slots: bool = False,
+                stage_aux_weight: float | None = None):
     """Differentiable entry point: ``(loss, aux) = onef1b_loss(...)``
     behaves like a plain function of (stage_params, head_params, xs)
     under ``jax.grad`` / ``value_and_grad`` (differentiate the loss;
@@ -436,13 +470,14 @@ def onef1b_loss(stage_fn: Callable, loss_fn: Callable, stage_params,
     def f(sp, hp, x):
         out = onef1b_schedule(stage_fn, loss_fn, sp, hp, x,
                               axis_name, num_micro,
-                              masked_slots=masked_slots)
+                              masked_slots=masked_slots,
+                              stage_aux_weight=stage_aux_weight)
         return out[0], out[1]
 
     def fwd(sp, hp, x):
         loss, aux, gs, gh, gxs = onef1b_schedule(
             stage_fn, loss_fn, sp, hp, x, axis_name, num_micro,
-            masked_slots=masked_slots)
+            masked_slots=masked_slots, stage_aux_weight=stage_aux_weight)
         return (loss, aux), (gs, gh, gxs)
 
     def bwd(resid, cot):

@@ -465,8 +465,26 @@ class LocalSGDEngine:
         denom = jnp.maximum(denom, 1.0)  # data-derived: known pre-schedule
         stage_params = params["layers"]
         head_params = {k: v for k, v in params.items() if k != "layers"}
+        has_moe = getattr(tm, "num_experts", 0) > 0
+        aux_w = None
+        if has_moe:
+            # 1F1B x MoE (r5): the stage applies with mutable aux so the
+            # sown load-balance losses are captured (a plain apply would
+            # silently drop them); each microbatch contributes 1/m of
+            # the full-batch aux scale, further averaged over any
+            # batch-partial axes exactly as the standard path does
+            aux_w = self.cfg.moe_aux_weight / mnum
+            for ax in part:
+                aux_w = aux_w / self.mesh.shape[ax]
 
         def stage_fn(sp, x):
+            if has_moe:
+                y, mut = tm.apply({"params": {"layers": sp}}, x,
+                                  train=True, mode="stage",
+                                  mutable=["aux"])
+                a = sum(jnp.sum(l) for l in
+                        jax.tree_util.tree_leaves(mut["aux"]))
+                return y, a.astype(jnp.float32)
             return tm.apply({"params": {"layers": sp}}, x, train=True,
                             mode="stage")
 
@@ -495,7 +513,8 @@ class LocalSGDEngine:
             # the slots; a ppermute under a pipe-varying cond predicate
             # miscomputes (parallel/pp.py r5 note), so SP runs the
             # schedule with GPipe-style masked slots instead of skips
-            masked_slots=self.seq_axis is not None)
+            masked_slots=self.seq_axis is not None,
+            stage_aux_weight=aux_w)
         if part:
             # schedule aux counted this device's batch slice / seq chunk
             correct = lax.psum(correct, part)

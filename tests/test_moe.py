@@ -337,3 +337,55 @@ class TestDriverMoESequenceParallel:
         np.testing.assert_allclose(ep["global_train_losses"],
                                    moe_sp_run["global_train_losses"],
                                    rtol=2e-3)
+
+
+class TestDriverMoEOneF1B:
+    """1F1B x MoE (r5, the final 1F1B exclusion lifted): the stage
+    applies with mutable aux so the sown load-balance losses are
+    captured, the schedule adds them to its loss carry per valid fwd
+    slot, and the backward seeds the aux output's cotangent with the
+    (scaled) aux weight — differentiated through the schedule.  GPipe
+    under the same microbatching routes identically, so the 1F1B run
+    must reproduce the GPipe moe x pp run."""
+
+    def _run(self, devices, mesh_axes, **kw):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.mesh import build_mesh
+        mesh = build_mesh(mesh_axes, devices)
+        cfg = Config(model="bert_tiny", dataset="synthetic_mlm",
+                     epochs_global=2, epochs_local=1, batch_size=8,
+                     limit_train_samples=128, limit_eval_samples=32,
+                     compute_dtype="float32", augment=False,
+                     aggregation_by="weights", seed=7, num_experts=4,
+                     pp_microbatches=2, **kw)
+        return train_global(cfg, mesh=mesh, progress=False)
+
+    def test_1f1b_moe_matches_gpipe(self, devices):
+        """Default aux weight ACTIVE: the trajectory only matches the
+        GPipe twin if the aux loss is both captured and differentiated
+        correctly through the schedule."""
+        gpipe = self._run(devices[:4], {"data": 2, "pipe": 2})
+        onef = self._run(devices[:4], {"data": 2, "pipe": 2},
+                         pp_schedule="1f1b")
+        np.testing.assert_allclose(onef["global_train_losses"],
+                                   gpipe["global_train_losses"], rtol=2e-3)
+        for a, b in zip(jax.tree_util.tree_leaves(onef["state"].params),
+                        jax.tree_util.tree_leaves(gpipe["state"].params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-4)
+
+    def test_1f1b_moe_ep_matches_gpipe_ep(self, devices):
+        """The EP triple: expert stacks sharded over 'expert' behind the
+        'pipe' layer dim, under the 1F1B schedule.  Params compared too
+        (same structure): an EP-specific aux-cotangent bug below loss
+        visibility would otherwise pass (code-review r5)."""
+        gpipe = self._run(devices[:8], {"data": 2, "pipe": 2, "expert": 2})
+        onef = self._run(devices[:8], {"data": 2, "pipe": 2, "expert": 2},
+                         pp_schedule="1f1b")
+        np.testing.assert_allclose(onef["global_train_losses"],
+                                   gpipe["global_train_losses"], rtol=2e-3)
+        for a, b in zip(jax.tree_util.tree_leaves(onef["state"].params),
+                        jax.tree_util.tree_leaves(gpipe["state"].params)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-4)
