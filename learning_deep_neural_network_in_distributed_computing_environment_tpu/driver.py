@@ -151,17 +151,16 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             raise ValueError(
                 f"--pp_schedule 1f1b applies under pipeline parallelism "
                 f"(a '{PIPE_AXIS}' mesh axis of size >= 2)")
-        if not cfg.model.startswith(("bert", "gpt", "llama")):
+        if not cfg.model.startswith(("bert", "gpt", "llama", "vit")):
             raise NotImplementedError(
-                "--pp_schedule 1f1b supports bert_*/gpt_*/llama_* (the "
-                "per-microbatch head+loss runs inside the schedule); "
-                "vit_* has no 1f1b head decomposition yet — use the "
-                "GPipe schedule")
+                "--pp_schedule 1f1b supports bert_*/gpt_*/llama_*/vit_* "
+                "(the per-microbatch head+loss runs inside the schedule)")
         # r5: 1F1B composes with TP (vocab-parallel head in the
         # schedule's head slot), SP (masked fwd/bwd slots), FSDP
-        # (ZeRO-3 gather outside the schedule), and MoE/EP (stage aux
+        # (ZeRO-3 gather outside the schedule), MoE/EP (stage aux
         # captured via mutable apply and differentiated through the
-        # schedule with a weight-valued cotangent).
+        # schedule with a weight-valued cotangent), and every model
+        # family incl. ViT (embed/stage/head mode decomposition).
         # 1F1B x SP (r5): the schedule runs its fwd/bwd slots in
         # GPipe-style MASKED mode under SP (train.py passes
         # masked_slots) — a ppermute inside a pipe-varying lax.cond

@@ -86,7 +86,17 @@ class ViT(nn.Module):
     patchify: str = "einsum"
 
     @nn.compact
-    def __call__(self, x, *, train: bool = False):
+    def __call__(self, x, *, train: bool = False, mode: str = "full"):
+        """``mode`` partitions the forward for the 1F1B engine path
+        (parallel/pp.py): 'embed' -> patchified + position-embedded
+        activations, 'stage' -> apply this device's local scanned
+        layers, 'head' -> mean-pool + classifier on activations.
+        'full' (default) is the ordinary forward; init always uses it
+        so every mode shares one parameter structure."""
+        if mode == "stage":
+            return self._encode_scanned(x, train, as_stage=True)
+        if mode == "head":
+            return self._head(x)
         b, h, w, c = x.shape
         p = self.patch
         if h % p or w % p:
@@ -105,6 +115,8 @@ class ViT(nn.Module):
                          name="patch_embed")(x)
         pos = self.param("pos_emb", _init, (1, x.shape[1], self.hidden))
         x = x + pos.astype(x.dtype)
+        if mode == "embed":
+            return x
         if self.scan_layers:
             x = self._encode_scanned(x, train)
         else:
@@ -119,16 +131,20 @@ class ViT(nn.Module):
                                  ep_size=self.ep_size,
                                  capacity_factor=self.capacity_factor,
                                  name=f"layer{i}")(x, train=train)
+        return self._head(x)
+
+    def _head(self, x):
         x = x.mean(axis=1)  # global average pool over patches
         return nn.Dense(self.num_classes, kernel_init=_init,
                         dtype=jnp.float32, name="head")(
                             jnp.asarray(x, jnp.float32))
 
-    def _encode_scanned(self, x, train: bool):
+    def _encode_scanned(self, x, train: bool, as_stage: bool = False):
         from .bert import apply_scanned_stack
         return apply_scanned_stack(
             _ScanLayer, x, num_layers=self.num_layers, pp_size=self.pp_size,
-            pipeline_axis=self.pipeline_axis, remat=self.remat,
+            pipeline_axis=None if as_stage else self.pipeline_axis,
+            remat=self.remat,
             num_microbatches=self.num_microbatches, train=train,
             num_heads=self.num_heads, ffn_dim=self.ffn_dim,
             dtype=self.dtype, attention_impl=self.attention_impl,

@@ -339,6 +339,15 @@ class TestDriverMoESequenceParallel:
                                    rtol=2e-3)
 
 
+def _assert_params_close(res, ref, rtol=2e-3, atol=2e-4):
+    """Final-parameter comparison between two driver runs with identical
+    parameter structure (shared by the 1F1B MoE tests below)."""
+    for a, b in zip(jax.tree_util.tree_leaves(res["state"].params),
+                    jax.tree_util.tree_leaves(ref["state"].params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=rtol, atol=atol)
+
+
 class TestDriverMoEOneF1B:
     """1F1B x MoE (r5, the final 1F1B exclusion lifted): the stage
     applies with mutable aux so the sown load-balance losses are
@@ -370,10 +379,7 @@ class TestDriverMoEOneF1B:
                          pp_schedule="1f1b")
         np.testing.assert_allclose(onef["global_train_losses"],
                                    gpipe["global_train_losses"], rtol=2e-3)
-        for a, b in zip(jax.tree_util.tree_leaves(onef["state"].params),
-                        jax.tree_util.tree_leaves(gpipe["state"].params)):
-            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                       rtol=2e-3, atol=2e-4)
+        _assert_params_close(onef, gpipe)
 
     def test_1f1b_moe_ep_matches_gpipe_ep(self, devices):
         """The EP triple: expert stacks sharded over 'expert' behind the
@@ -385,7 +391,4 @@ class TestDriverMoEOneF1B:
                          pp_schedule="1f1b")
         np.testing.assert_allclose(onef["global_train_losses"],
                                    gpipe["global_train_losses"], rtol=2e-3)
-        for a, b in zip(jax.tree_util.tree_leaves(onef["state"].params),
-                        jax.tree_util.tree_leaves(gpipe["state"].params)):
-            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                       rtol=2e-3, atol=2e-4)
+        _assert_params_close(onef, gpipe)

@@ -368,6 +368,20 @@ class TestOneF1B:
             assert d.max() < cap and d.mean() < 2e-3, (
                 jax.tree_util.keystr(path), d.max(), d.mean())
 
+    def test_driver_1f1b_vit_classifier_head(self, devices):
+        """ViT under 1f1b (r5): the image family's embed (patchify +
+        pos) / stage (encoder layers) / head (mean-pool + classifier)
+        decomposition — classification labels exercise the engine's
+        label-shape-generic microbatching.  Trajectory must match the
+        dense twin."""
+        run = TestDriverPipelineParallel()
+        kw = dict(model="vit_tiny", dataset="cifar10")
+        dense = run._run(devices[:2], {"data": 2}, **kw)
+        pp = run._run(devices[:4], {"data": 2, "pipe": 2},
+                      pp_schedule="1f1b", pp_microbatches=4, **kw)
+        np.testing.assert_allclose(pp["global_train_losses"],
+                                   dense["global_train_losses"], rtol=2e-3)
+
     def test_driver_1f1b_tp_bert_untied_head(self, devices):
         """1F1B x TP with BERT's UNTIED vocab-parallel MLM decode (the
         other head construction): trajectory matches the dense twin."""
