@@ -10,8 +10,12 @@ time breakdown — convolution/matmul (MXU) vs everything else — so the
 an instrument that shares nothing with the harness that produced it.
 
 Usage (TPU host):  python tools/profile_roofline.py [model ...]
-Writes the trace under /tmp/jax_trace_<model> and prints a per-category
-device-time table plus the fraction of wall covered by device ops.
+Models: resnet50 vit_s16 bert_base gpt2_4k_flash llama llama_gqa4
+(default: resnet50 vit_s16).  Writes the trace under
+/tmp/jax_trace_<model> and prints a per-category device-time table, a
+top-ops-by-name table (attributes Pallas custom calls, which the cost
+model scores as zero-FLOP), and the fraction of wall covered by device
+ops.
 """
 
 from __future__ import annotations
@@ -40,6 +44,15 @@ CONFIGS = {
                           num_classes=50257, token=True,
                           model_kw=dict(attention_impl="flash",
                                         max_len=4096)),
+    # the modern-decoder ladder rows (RMSNorm/RoPE/SwiGLU + flash; GQA
+    # variant shares the config via num_kv_heads)
+    "llama": dict(name="llama_medium", shape=(1024,), batch=8,
+                  num_classes=32000, token=True,
+                  model_kw=dict(attention_impl="flash")),
+    "llama_gqa4": dict(name="llama_medium", shape=(1024,), batch=8,
+                       num_classes=32000, token=True,
+                       model_kw=dict(attention_impl="flash",
+                                     num_kv_heads=4)),
 }
 
 
