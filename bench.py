@@ -741,7 +741,7 @@ def _emit_headline(details: dict, extra: dict) -> None:
         "vs_baseline": round(value / 50.0, 3) if value else None,
         "details": d,
     }
-    for k2 in ("bw_gbps", "fetch_ms"):
+    for k2 in ("bw_gbps", "bw_gbps_end", "fetch_ms"):
         if extra.get(k2) is not None:
             payload[k2] = extra[k2]
     line = json.dumps(payload)
@@ -877,6 +877,22 @@ def main() -> None:
             except Exception as e:  # noqa: BLE001
                 print(f"[bench] torch baseline failed: {e}", file=sys.stderr)
         _emit_headline(details, extra)
+    # closing bandwidth calibration: a start/end pair makes a DEGRADED
+    # DEVICE WINDOW self-evident in the artifact (r5: one rehearsal ran
+    # 15-25% slow across every row with start bw at 597 vs the usual
+    # ~665 GB/s — without the pair, depressed MFU reads as a software
+    # regression instead of the transient it was)
+    # skipped when tainted: an abandoned timed-out thread still hammering
+    # the device would depress the closing number — the exact false
+    # "degraded window" signal the pair exists to rule out (code-review)
+    if _remaining() > 30 and not _TAINTED:
+        try:
+            bw2 = measure_hbm_bandwidth()
+            if bw2:
+                extra["bw_gbps_end"] = bw2["gbps"]
+        except Exception as e:  # noqa: BLE001
+            print(f"[bench] closing bandwidth calibration failed: {e}",
+                  file=sys.stderr)
     _emit_headline(details, extra)
     sys.stdout.flush()
     sys.stderr.flush()
