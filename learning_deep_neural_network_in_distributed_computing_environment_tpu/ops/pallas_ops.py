@@ -44,7 +44,10 @@ BK = 1024  # key/value block
 # 51.5 -> 53.3% end to end.  Mechanism: doubling BQ halves the number
 # of query-block sweeps ni, which halves the K/V HBM re-fetch traffic
 # (K/V blocks stream once per (i, j) cell) and the per-grid-step
-# pipeline overhead; the per-element softmax/exp work is BQ-invariant)
+# pipeline overhead; the per-element softmax/exp work is BQ-invariant.
+# The sweep is closed upward: (1024, 2048) measured worse at both
+# L=2048 and L=8192, and (2048, 1024) tied at L=8192 while failing to
+# lower at L=2048 — (1024, 1024) is the v5e optimum for d=64.)
 
 
 def _interpret() -> bool:
