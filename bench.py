@@ -81,15 +81,14 @@ def _remaining() -> float:
 
 def _setup_compile_cache() -> None:
     """Persistent XLA compilation cache in-repo: rehearsal runs pre-warm
-    the driver's end-of-round run (same host, same chip)."""
-    import jax
-    try:
-        jax.config.update("jax_compilation_cache_dir",
-                          os.path.join(REPO, ".jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-    except Exception as e:  # noqa: BLE001 — cache is an optimization only
-        print(f"[bench] compile cache unavailable: {e}", file=sys.stderr)
+    the driver's end-of-round run (same host, same chip).  Shares the
+    framework's cache wiring (xla_flags.setup_compile_cache), so bench,
+    CLI, and driver runs all hit one cache."""
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu.xla_flags import (
+        setup_compile_cache,
+    )
+    if not setup_compile_cache(os.path.join(REPO, ".jax_cache")):
+        print("[bench] compile cache unavailable", file=sys.stderr)
 
 
 def jax_fetch(state):
@@ -142,12 +141,14 @@ def _scan_rate(scank, state, k: int, samples: int = 3):
         t = max(time.perf_counter() - t0 - _FETCH_OVERHEAD, 1e-9)
         return k / t, 1.0, True, state
     good.sort()
-    if len(good) >= 6:
+    if len(diffs) > samples and len(good) >= 4:
         # the retry path ran (some sample disagreed > 30%): trim the two
         # extremes before the median/spread so ONE transient relay slow
         # window cannot dominate the reported pm no matter how many
         # clean samples surround it (r5 rehearsal: bert pm 37 MFU points
-        # from a single outlier among 7)
+        # from a single outlier among 7).  Keyed on the retry itself, not
+        # on the count of positive diffs — with two or more non-positive
+        # diffs the old len >= 6 gate let the outlier through (ADVICE r5)
         good = good[1:-1]
     med = good[len(good) // 2]
     spread = (good[-1] - good[0]) / (2 * med)
@@ -443,7 +444,14 @@ def measure_model(name: str, input_shape, batch: int, steps: int,
         "mfu_pct": round(100 * m, 2) if m is not None else None,
         "mfu_pm_pct": round(100 * m * spread, 2) if m is not None else None,
     }
-    if flops_basis:
+    if model_kw.get("attention_impl") == "flash":
+        # flash rows ALWAYS carry a basis so cross-run MFU comparisons can
+        # tell corrected from uncorrected numbers apart: "dense_twin" when
+        # the twin-FLOPs correction applied, else the raw cost-model count
+        # (which scores Pallas custom calls as zero FLOPs) — the absence
+        # of the field used to be the only marker (ADVICE r5)
+        out["basis"] = flops_basis or "xla_cost_model"
+    elif flops_basis:
         out["basis"] = flops_basis
     if fell_back:
         out["timing"] = "fallback"
@@ -525,6 +533,67 @@ def measure_flash_one_l(L: int, B: int) -> dict:
         "train_dense_ms": round(train["dense"] * 1e3, 3),
         "train_flash_ms": round(train["flash"] * 1e3, 3),
         "train_flash_speedup": round(train["dense"] / train["flash"], 3),
+    }
+
+
+def measure_round_gap() -> dict:
+    """Host time between device rounds: serial vs overlapped pipeline.
+
+    Runs the SAME small ``train_global`` config twice — ``overlap_rounds``
+    off, then on — and reads the per-round ``gap_ms`` the driver
+    instruments (wall from round r's state becoming ready to round r+1's
+    dispatch: the window where the device sits idle while the host
+    fetches + assembles metrics, re-partitions, and packs the next
+    round).  Per-round walls are pinned so both runs repartition
+    identically; the identical-results invariant (delayed-EMA semantics
+    make overlap scheduling-only) is asserted into the artifact."""
+    import numpy as np
+
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+
+    import jax
+    n = len(jax.devices())
+    walls = lambda e: np.ones(n)
+    kw = dict(model="mlp", dataset="mnist", epochs_global=6, epochs_local=1,
+              batch_size=64, limit_train_samples=4096,
+              limit_eval_samples=512, compute_dtype="float32",
+              augment=False, aggregation_by="weights",
+              proportionality="uniform", seed=0)
+    runs = {}
+    for label, overlap in (("serial", False), ("overlap", True)):
+        runs[label] = train_global(
+            Config(overlap_rounds=overlap, **kw), progress=False,
+            # probe + walls pinned so both runs partition identically and
+            # the identical-results invariant is measurable
+            simulated_durations=np.ones(n),
+            simulated_round_durations=walls)
+    identical = all(
+        runs["serial"][k] == runs["overlap"][k]
+        for k in ("global_train_losses", "global_val_accuracies",
+                  "step_caps", "shard_sizes"))
+
+    def gaps(res):
+        return [t["gap_ms"] for t in res["round_timings"] if "gap_ms" in t]
+
+    def mean_of(res, field):
+        # skip round 0: its stage_ms carries the one-time program compile
+        vals = [t[field] for t in res["round_timings"][1:] if field in t]
+        return round(float(np.mean(vals)), 2) if vals else None
+
+    gap_s = float(np.mean(gaps(runs["serial"])))
+    gap_o = float(np.mean(gaps(runs["overlap"])))
+    return {
+        "gap_serial_ms": round(gap_s, 2),
+        "gap_overlap_ms": round(gap_o, 2),
+        "reduction_x": round(gap_s / max(gap_o, 1e-3), 1),
+        "rounds": len(runs["serial"]["round_timings"]),
+        "results_identical": bool(identical),
+        # serial-mode breakdown of where the gap goes (overlap hides it)
+        "serial_stage_ms": mean_of(runs["serial"], "stage_ms"),
+        "serial_fetch_ms": mean_of(runs["serial"], "fetch_ms"),
+        "serial_assemble_ms": mean_of(runs["serial"], "assemble_ms"),
+        "serial_prep_ms": mean_of(runs["serial"], "prep_ms"),
     }
 
 
@@ -638,20 +707,31 @@ SHORT = {
     "llama_medium_lm_l1024": "llama",
     "llama_medium_gqa4_lm_l1024": "llama_gqa4",
     "flash_attention": "flash",
+    "round_gap": "rgap",
 }
 
 
 def _run_entry(key: str, entry_budget: float | None = None) -> dict:
     """Run one entry in this process (also the --entry debug CLI).
     ``flash:L<len>`` runs a single per-L flash unit — the same key main()
-    schedules and logs, so a failing unit can be replayed alone."""
+    schedules and logs, so a failing unit can be replayed alone.  Accepts
+    either the full ladder key or its compact headline alias (``r50`` ->
+    ``resnet50_imagenet``)."""
+    key = {v: k for k, v in SHORT.items()}.get(key, key)
     if key.startswith("flash:"):
-        L, B, _t = next(p for p in FLASH_POINTS
-                        if f"L{p[0]}" == key.split(":", 1)[1])
+        point = next((p for p in FLASH_POINTS
+                      if f"L{p[0]}" == key.split(":", 1)[1]), None)
+        if point is None:
+            # same clean exit every other bad key gets — not a bare
+            # StopIteration out of next() (ADVICE r5)
+            raise SystemExit(f"unknown entry {key}")
+        L, B, _t = point
         return measure_flash_one_l(L, B)
     if key == "flash_attention":
         return {f"L{L}": measure_flash_one_l(L, B)
                 for L, B, _t in FLASH_POINTS}
+    if key == "round_gap":
+        return measure_round_gap()
     for k, name, shape, batch, steps, ncls, tok, _tmo, *extra in LADDER:
         if k == key:
             return measure_model(name, shape, batch, steps, ncls, tok,
@@ -688,7 +768,14 @@ def _run_with_timeout(fn, tmo: float):
 # achievable-MFU ceiling the headline is read against — the measured
 # conv-fusion streaming rate (759 GB/s, 93% of spec) shows the step
 # already runs at ~94% of this ceiling (VERDICT r4 'next' #7).
+# VALID ONLY for the traced (device, geometry): TPU v5e, batch 128 at
+# 224^2 — ceiling_mfu emission is gated on both below so the number is
+# never silently wrong on other hardware or a re-laddered entry
+# (ADVICE r5); flops_per_step tracks config changes but this byte count
+# cannot.
 R50_TRACED_HBM_BYTES = 36.4e9
+R50_TRACED_BATCH = 128
+R50_TRACED_DEVICE_SUBSTRS = ("v5e", "v5 lite")
 
 # Field-drop order if the headline line ever exceeds the byte cap.
 _DROP_ORDER = ("ms", "pm", "roof", "ips")
@@ -713,6 +800,11 @@ def _emit_headline(details: dict, extra: dict) -> None:
             d[sk] = "skip"
         elif e.get("error"):
             d[sk] = None
+        elif key == "round_gap":
+            d[sk] = {"ser": e.get("gap_serial_ms"),
+                     "ovl": e.get("gap_overlap_ms"),
+                     "x": e.get("reduction_x"),
+                     "same": 1 if e.get("results_identical") else 0}
         elif key == "flash_attention":
             def _flash_cell(r):
                 if "train_flash_speedup" not in r:
@@ -727,7 +819,7 @@ def _emit_headline(details: dict, extra: dict) -> None:
                    "ms": e.get("step_ms"), "roof": e.get("hbm_roofline_frac"),
                    "pm": e.get("mfu_pm_pct")}
             for passthru in ("vs_torch_cpu", "bound", "timing", "basis",
-                             "ceiling_mfu"):
+                             "ceiling_mfu", "ceiling_basis"):
                 if e.get(passthru) is not None:
                     ent[passthru] = e[passthru]
             if e.get("tainted_after_timeout"):
@@ -814,7 +906,10 @@ def main() -> None:
     if not fast:
         at = next(i for i, (k, _t) in enumerate(jobs)
                   if k.startswith("vit_"))
-        jobs[at:at] = [(f"flash:L{L}", t) for L, _b, t in FLASH_POINTS]
+        # round_gap (the overlapped-pipeline host-gap A/B) + per-L flash
+        # units run before the sacrificial ViT tail
+        jobs[at:at] = ([("round_gap", 150)]
+                       + [(f"flash:L{L}", t) for L, _b, t in FLASH_POINTS])
     for key, tmo in jobs:
         rem = _remaining()
         # an entry needs headroom to be worth starting: compile (fast on a
@@ -859,13 +954,25 @@ def main() -> None:
               file=sys.stderr)
         if key == "resnet50_imagenet" and res.get("flops_per_step"):
             try:
+                import jax
                 from learning_deep_neural_network_in_distributed_computing_environment_tpu.utils import (
                     hbm_bytes_per_sec, peak_flops)
-                spec_bw, peak = hbm_bytes_per_sec(), peak_flops()
-                if spec_bw and peak:
-                    res["ceiling_mfu"] = round(
-                        100 * res["flops_per_step"]
-                        / (R50_TRACED_HBM_BYTES / spec_bw) / peak, 1)
+                kind = jax.devices()[0].device_kind.lower()
+                entry_batch = next(b for k2, _n, _s, b, *_x in LADDER
+                                   if k2 == "resnet50_imagenet")
+                if (any(s in kind for s in R50_TRACED_DEVICE_SUBSTRS)
+                        and entry_batch == R50_TRACED_BATCH):
+                    spec_bw, peak = hbm_bytes_per_sec(), peak_flops()
+                    if spec_bw and peak:
+                        res["ceiling_mfu"] = round(
+                            100 * res["flops_per_step"]
+                            / (R50_TRACED_HBM_BYTES / spec_bw) / peak, 1)
+                        res["ceiling_basis"] = "traced:v5e_b128_r5"
+                else:
+                    print(f"[bench] r50 ceiling skipped: traced bytes are "
+                          f"v5e/batch-{R50_TRACED_BATCH} only (device "
+                          f"{kind!r}, batch {entry_batch})",
+                          file=sys.stderr)
             except Exception as e:  # noqa: BLE001
                 print(f"[bench] r50 ceiling unavailable: {e}",
                       file=sys.stderr)
@@ -902,8 +1009,17 @@ def main() -> None:
 
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--entry":
+        # the debug CLI honors BENCH_BUDGET_S like the sweep: the backstop
+        # re-prints a parseable status line and exits 0 at the deadline,
+        # so tools/verify.sh can smoke-run a heavy entry on slow hosts
+        # (CPU-only CI) without hanging
+        _T0 = time.perf_counter()
+        _LAST_LINE = json.dumps(
+            {"entry": sys.argv[2], "status": "budget_backstop"})
         _setup_compile_cache()
+        _arm_backstop()
         measure_fetch_overhead()
-        print(json.dumps(_run_entry(sys.argv[2])))
+        print(json.dumps(_run_entry(sys.argv[2])), flush=True)
+        os._exit(0)  # don't linger on watchdog-abandoned threads
     else:
         main()

@@ -34,4 +34,15 @@ __version__ = "0.1.0"
 
 __all__ = [
     "__version__",
+    "shard_map",
 ]
+
+
+def __getattr__(name):
+    # lazy: ``ldnde_tpu.shard_map`` resolves the JAX-version compat shim
+    # (jax.shard_map, or the experimental one on legacy JAX) without making
+    # the package root import jax eagerly
+    if name == "shard_map":
+        from .compat import shard_map
+        return shard_map
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

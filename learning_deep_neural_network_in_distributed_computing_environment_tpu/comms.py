@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .compat import axis_size, shard_map
 from .mesh import DATA_AXIS
 
 PyTree = Any
@@ -69,7 +70,7 @@ def aggregate(tree: PyTree, *, how: str = "equal",
         raise ValueError(f"how must be one of {HOWS}, got {how!r}")
     if topology not in TOPOLOGIES:
         raise ValueError(f"topology must be one of {TOPOLOGIES}, got {topology!r}")
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     if n == 1:
         return tree
     w = local_weight
@@ -116,7 +117,7 @@ def make_host_aggregator(mesh, *, how: str, topology: str,
             out = aggregate(squeezed, how=how, topology=topology,
                             local_weight=local_weight)
             return jax.tree_util.tree_map(lambda x: x[None], out)
-        return jax.shard_map(
+        return shard_map(
             inner, mesh=mesh, in_specs=(spec,), out_specs=spec)(tree)
 
     return jax.jit(_agg)

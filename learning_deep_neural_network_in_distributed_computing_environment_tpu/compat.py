@@ -1,0 +1,140 @@
+"""JAX version compatibility shims.
+
+The framework targets the modern ``jax.shard_map`` API with
+varying-manual-axes (vma) typing; installed runtimes as old as JAX 0.4.37
+predate it (``shard_map`` still lives in ``jax.experimental``,
+``jax.typeof`` / ``lax.pcast`` / ``lax.axis_size`` don't exist, and
+``jax.ShapeDtypeStruct`` has no ``vma`` kwarg).  Everything the package
+needs from the newer surface funnels through this module:
+
+- ``shard_map``   — ``jax.shard_map`` when present, else the experimental
+  one wrapped to accept the modern keyword spelling (``check_vma`` maps to
+  the legacy ``check_rep``, whose replication-tracking rewrite is the
+  semantic twin of vma typing for everything this package does);
+- ``typeof``      — ``jax.typeof`` or an aval lookup.  Callers only ever
+  read ``getattr(typeof(x), "vma", ...)``, and legacy avals simply don't
+  carry the attribute, so the defaults kick in;
+- ``pcast``       — the legacy rewrite's ``pbroadcast`` for the
+  replicated->varying direction (the only one call sites use); the
+  legacy ``check_rep`` machinery tracks the rest on its own;
+- ``axis_size``   — ``lax.psum(1, axis)`` on legacy JAX (constant-folded
+  to a concrete int for non-tracer inputs, which is all callers pass);
+- ``shape_dtype_struct`` — drops the ``vma`` kwarg when unsupported.
+
+``install()`` additionally publishes the missing names onto ``jax`` /
+``jax.lax`` so code referencing ``jax.shard_map`` directly (the seed test
+suite does) runs on either version.  It is explicit opt-in —
+``tests/conftest.py`` calls it; importing the package alone never
+monkeypatches the global jax namespace.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+from jax import lax
+
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, *, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=None, check_rep=None, **kw):
+        """``jax.shard_map``'s keyword surface on legacy JAX."""
+        if check_rep is None:
+            check_rep = True if check_vma is None else check_vma
+        return _legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                 out_specs=out_specs, check_rep=check_rep,
+                                 **kw)
+
+
+if hasattr(jax, "typeof"):
+    typeof = jax.typeof
+else:
+    def typeof(x):
+        """Aval of a value/tracer; legacy avals carry no ``vma`` attribute,
+        which the ``getattr(..., "vma", default)`` call sites expect."""
+        return jax.core.get_aval(x)
+
+
+if hasattr(lax, "pcast"):
+    pcast = lax.pcast
+else:
+    from jax.experimental.shard_map import pbroadcast as _legacy_pbroadcast
+
+    def pcast(x, axes, *, to=None):
+        """Legacy twin of ``lax.pcast(..., to="varying")``: the legacy
+        rewrite's ``pbroadcast`` declares a replicated value varying over
+        ``axes`` (rep R -> R - axes), which is what keeps zero-initialized
+        scan carries type-matched with their varying body outputs under
+        ``check_rep``.  Only the replicated->varying direction exists;
+        that is the only direction call sites use."""
+        if to == "varying" and axes:
+            return _legacy_pbroadcast(x, tuple(axes))
+        return x
+
+
+if hasattr(lax, "axis_size"):
+    axis_size = lax.axis_size
+else:
+    def axis_size(axis_name):
+        """Size of a bound mesh axis; ``psum`` of a literal constant-folds
+        to a concrete int, matching ``lax.axis_size`` for host callers."""
+        return lax.psum(1, axis_name)
+
+
+# True when the runtime predates the jax.shard_map / vma-typing surface;
+# legacy-only workarounds (re-certified replication, the custom-vjp
+# optimization barrier) key off this
+LEGACY_SHARD_MAP = not hasattr(jax, "shard_map")
+
+
+if LEGACY_SHARD_MAP:
+    # legacy JAX has no differentiation rule for optimization_barrier; the
+    # barrier orders the FORWARD collectives (the CPU rendezvous-deadlock
+    # workaround), so the cotangent passes straight through
+    @jax.custom_vjp
+    def optimization_barrier(x):
+        return lax.optimization_barrier(x)
+
+    def _ob_fwd(x):
+        return lax.optimization_barrier(x), None
+
+    def _ob_bwd(_, g):
+        return (g,)
+
+    optimization_barrier.defvjp(_ob_fwd, _ob_bwd)
+else:
+    optimization_barrier = lax.optimization_barrier
+
+
+_SDS_HAS_VMA = "vma" in inspect.signature(
+    jax.ShapeDtypeStruct.__init__).parameters
+
+
+def shape_dtype_struct(shape, dtype, vma=None):
+    """``jax.ShapeDtypeStruct`` that tolerates the ``vma`` kwarg missing
+    from legacy JAX (callers pass ``vma=None`` outside shard_map anyway)."""
+    if _SDS_HAS_VMA:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def install() -> None:
+    """Publish the shims onto ``jax`` / ``jax.lax`` when the runtime lacks
+    them, so direct ``jax.shard_map`` / ``lax.pcast`` references (tests,
+    notebooks) work unmodified on legacy JAX.  Idempotent; never overrides
+    a real implementation.  Deliberately NOT run at import: the package's
+    own modules import the shims explicitly, so merely importing the
+    package never monkeypatches the global jax namespace — callers that
+    want the global names (tests/conftest.py does) opt in."""
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = shard_map
+    if not hasattr(jax, "typeof"):
+        jax.typeof = typeof
+    if not hasattr(lax, "pcast"):
+        lax.pcast = pcast
+    if not hasattr(lax, "axis_size"):
+        lax.axis_size = axis_size

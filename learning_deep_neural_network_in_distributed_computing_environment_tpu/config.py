@@ -102,6 +102,21 @@ class Config:
     # steps (host window + async double-buffered transfer) instead of
     # materializing the whole epoch — required at ImageNet scale.
     stream_chunk_steps: int = 0
+    # Streamed-path producer thread: how many packed windows may be staged
+    # on device ahead of the consumer (2 = double buffering); 0 packs and
+    # stages synchronously.
+    stream_prefetch: int = 2
+    # Overlapped round pipeline: dispatch round r, then fetch/assemble its
+    # metrics on a worker thread and re-partition + pack round r+1 on the
+    # host while the device computes — the between-round host gap hides
+    # behind device time.  The straggler EMA consumes measured walls one
+    # round delayed in BOTH modes, so overlapped and serial runs produce
+    # identical results (False = fully serial, for debugging/benchmarks).
+    overlap_rounds: bool = True
+    # Persistent XLA compilation cache directory ("" = disabled).  The
+    # CLI defaults this to .jax_cache so bench/multi-run invocations on
+    # one host stop paying recompiles; library/test callers opt in.
+    compile_cache_dir: str = ""
 
     def __post_init__(self) -> None:
         _choices("backend", self.backend, ("jax", "gloo", "nccl", "mpi"))
@@ -236,6 +251,18 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--stream_chunk_steps", type=int, default=d.stream_chunk_steps,
                    help="stream the round in chunks of this many steps "
                         "(0 = materialize the whole epoch)")
+    p.add_argument("--stream_prefetch", type=int, default=d.stream_prefetch,
+                   help="streamed-path producer depth: windows staged on "
+                        "device ahead of compute (2 = double buffering, "
+                        "0 = synchronous)")
+    p.add_argument("--no_overlap_rounds", action="store_true",
+                   help="disable the overlapped round pipeline (serial "
+                        "fetch/assemble/re-partition between rounds; same "
+                        "results, larger device gap)")
+    p.add_argument("--compile_cache_dir", type=str, default=".jax_cache",
+                   help="persistent XLA compilation cache directory "
+                        "('' disables); repeated runs on one host skip "
+                        "recompiles")
     return p
 
 
@@ -257,4 +284,11 @@ def config_from_args(argv: list[str] | None = None) -> Config:
     field_names = {f.name for f in dataclasses.fields(Config)}
     kw = {k: v for k, v in vars(args).items() if k in field_names}
     kw["augment"] = not args.no_augment
-    return Config(**kw)
+    kw["overlap_rounds"] = not args.no_overlap_rounds
+    cfg = Config(**kw)
+    if cfg.compile_cache_dir:
+        # arm the persistent compile cache up front so even the probe /
+        # init compiles hit it (driver re-arms for library callers)
+        from .xla_flags import setup_compile_cache
+        setup_compile_cache(cfg.compile_cache_dir)
+    return cfg

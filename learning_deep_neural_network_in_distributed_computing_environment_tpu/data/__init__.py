@@ -14,5 +14,6 @@ from .partition import (  # noqa: F401
     skew_partition,
     skew_repartition,
     step_budget,
+    window_feed,
 )
 from .augment import augment_batch  # noqa: F401

@@ -231,3 +231,32 @@ def pack_shard(images: np.ndarray, labels: np.ndarray, indices: np.ndarray,
     """Materialize one worker's WHOLE epoch (= the window starting at step
     0); kept for small datasets and the whole-round program."""
     return pack_window(images, labels, indices, batch_size, 0, num_steps)
+
+
+def window_feed(images: np.ndarray, labels: np.ndarray,
+                idxs: list[np.ndarray], batch_size: int, chunk_steps: int,
+                total_steps: int):
+    """Per-epoch iterator factory for the streamed input pipeline.
+
+    Returns ``gen(epoch) -> iterator`` of fixed-shape stacked windows
+    (x [N, chunk, B, ...], y [N, chunk, B, ...], m [N, chunk, B]) covering
+    steps [0, total_steps) in chunk_steps strides — the unit the round's
+    producer thread packs and stages while the previous chunk computes.
+    Only the window being packed is ever materialized on the host.
+    ``total_steps`` must be a multiple of ``chunk_steps`` (callers round
+    the step budget up; the masks zero the padding tail).
+    """
+    if total_steps % chunk_steps:
+        raise ValueError(
+            f"total_steps {total_steps} not a multiple of chunk_steps "
+            f"{chunk_steps} — fixed-shape windows would ragged-tail")
+
+    def gen(epoch):
+        del epoch  # every local epoch replays the same shard order
+        for s0 in range(0, total_steps, chunk_steps):
+            xs, ys, ms = zip(*(
+                pack_window(images, labels, p, batch_size, s0, chunk_steps)
+                for p in idxs))
+            yield np.stack(xs), np.stack(ys), np.stack(ms)
+
+    return gen

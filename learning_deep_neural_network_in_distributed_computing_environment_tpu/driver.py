@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import logging
 import time
+from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Sequence
 
 import jax
@@ -36,12 +37,12 @@ from .data import (
     fixed_classes_for_rank,
     load_dataset,
     pack_shard,
-    pack_window,
     repartition,
     skew_partition,
     skew_repartition,
     step_budget,
     train_val_split,
+    window_feed,
 )
 from . import checkpoint as ckpt_lib
 from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS,
@@ -54,6 +55,42 @@ log = logging.getLogger(__name__)
 
 def _round_up(x: int, mult: int) -> int:
     return ((x + mult - 1) // mult) * mult if x else mult
+
+
+def _assemble_round_metrics(results: dict, mx: dict, n: int) -> None:
+    """One round's mx arrays -> the reference metric lists.
+
+    Vectorized rewrite of the reference's nested per-epoch/per-worker
+    assembly loops (``trainer.py:49-171`` semantics): numpy boolean
+    indexing replaces the per-element Python iteration, producing the
+    SAME lists in the SAME order — row-major masking of [E, S] is the
+    original epoch-major extend order per worker, of [N, S] the original
+    worker-major order per epoch.  Runs on the metric worker thread in
+    the overlapped pipeline, inline in serial mode."""
+    bl = np.asarray(mx["batch_losses"])          # [N, E, S]
+    valid = np.asarray(mx["batch_mask"]) > 0
+    epochs_local = bl.shape[1]
+    for i in range(n):
+        results["all_workers_losses"][i].extend(bl[i][valid[i]].tolist())
+    for e in range(epochs_local):
+        results["all_epochs_losses"].append(bl[:, e][valid[:, e]].tolist())
+    results["global_epoch_losses"].append(
+        bl.transpose(1, 0, 2)[valid.transpose(1, 0, 2)].tolist())
+    results["global_epoch_accuracies"].append(
+        np.asarray(mx["avg_acc"])[0].tolist())
+    results["global_train_losses"].append(float(mx["global_train_loss"][0]))
+    results["global_train_accuracies"].append(float(mx["global_train_acc"][0]))
+    results["global_val_losses"].append(float(mx["global_val_loss"][0]))
+    results["global_val_accuracies"].append(float(mx["global_val_acc"][0]))
+    # rank-0 per-local-epoch curves (trainer.py:122-126)
+    results["worker_specific_train_losses"].extend(
+        np.asarray(mx["train_loss"])[0].tolist())
+    results["worker_specific_train_accuracies"].extend(
+        np.asarray(mx["train_acc"])[0].tolist())
+    results["worker_specific_val_losses"].extend(
+        np.asarray(mx["val_loss"])[0].tolist())
+    results["worker_specific_val_accuracies"].extend(
+        np.asarray(mx["val_acc"])[0].tolist())
 
 
 def build_model_for(cfg: Config, num_classes: int, **extra):
@@ -107,6 +144,11 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     ``datasets``: optional (train, val, test) ``Dataset`` triple override.
     """
     initialize_distributed()
+    if cfg.compile_cache_dir:
+        # persistent XLA compilation cache: bench/test/multi-run
+        # invocations on the same host stop paying round-program recompiles
+        from .xla_flags import setup_compile_cache
+        setup_compile_cache(cfg.compile_cache_dir)
     if mesh is None:
         axes = cfg.mesh_axes()
         if cfg.num_workers:
@@ -444,14 +486,7 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         chunk = cfg.stream_chunk_steps
         idxs, sizes = _capped(parts, caps)
         steps = _round_up(step_budget(sizes, batch), chunk)
-
-        def gen(epoch):
-            for s0 in range(0, steps, chunk):
-                xs, ys, ms = zip(*(
-                    pack_window(ds.images, ds.labels, p, batch, s0, chunk)
-                    for p in idxs))
-                yield np.stack(xs), np.stack(ys), np.stack(ms)
-        return gen
+        return window_feed(ds.images, ds.labels, idxs, batch, chunk, steps)
 
     # --- optional profiler trace (beyond-reference, SURVEY.md section 5) --
     profiling = False
@@ -462,8 +497,20 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         except Exception as e:  # some PJRT plugins lack profiler support
             log.warning("profiler unavailable: %s", e)
 
-    # --- the global-epoch loop ------------------------------------------
+    # --- the overlapped round pipeline ----------------------------------
+    # Every round is dispatched asynchronously; the metric fetch + assembly
+    # run on a worker thread and the next round's re-partition + packing
+    # run on the main thread, all WHILE the device computes the current
+    # round (cfg.overlap_rounds; serial mode runs the identical data flow
+    # inline).  The one semantic consequence is made explicit: the
+    # straggler-feedback EMA consumes MEASURED WALLS ONE ROUND DELAYED —
+    # round r+1's partition must be packed while round r is still running,
+    # so the freshest wall it can consume is round r-1's.  Serial mode
+    # uses the same delayed consumption, making overlapped and serial runs
+    # produce bit-identical results.
     results["step_caps"] = []
+    results["shard_sizes"] = []      # per-round per-worker train-shard sizes
+    results["round_timings"] = []    # per-round stage/compute/fetch/assemble
     epoch_iter = range(start_epoch, cfg.epochs_global)
     pbar = None
     if progress and jax.process_index() == 0:
@@ -474,111 +521,67 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             epoch_iter = pbar
         except ImportError:
             pass
-    for global_epoch in epoch_iter:
-        # straggler protocol: per-worker step cap from the current
-        # sec/batch estimate (probe-seeded, then updated from the measured
-        # round wall time below) and the time_limit grace budget
+    # Multi-host: the metric fetch is a COLLECTIVE (process_allgather);
+    # running it on a worker thread would interleave with the main
+    # thread's collectives (walls exchange, checkpoint gather, the next
+    # round itself) in different per-process orders — a rendezvous
+    # hazard.  Overlap therefore applies single-process only; multi-host
+    # keeps the serial data flow (identical results either way).
+    overlap = cfg.overlap_rounds and jax.process_count() == 1
+    streaming = cfg.stream_chunk_steps > 0
+
+    def build_inputs(tparts, vparts, caps):
+        if streaming:
+            return (chunk_feed(trainset, tparts, caps),
+                    chunk_feed(valset, vparts))
+        # pack AND stage onto device at prep time: in the overlapped
+        # pipeline this runs while the previous round computes, so the
+        # host->device transfer rides under device time too
+        return engine.stage_pack(pack_all(trainset, tparts, caps),
+                                 pack_all(valset, vparts))
+
+    def make_prep(tparts, vparts):
+        """Caps + packed/staged inputs for the round about to run, from
+        the CURRENT sec_per_batch estimate (straggler protocol: per-worker
+        step cap from the probe-seeded, measured-wall-updated EMA and the
+        time_limit grace budget)."""
         caps = [budget_from_time_limit(
             int(np.ceil(len(p) / batch)), float(sec_per_batch[i]),
-            cfg.time_limit) for i, p in enumerate(train_parts)]
-        results["step_caps"].append(list(caps))
+            cfg.time_limit) for i, p in enumerate(tparts)]
         steps_run = np.array([
             min(int(np.ceil(len(p) / batch)), caps[i])
-            for i, p in enumerate(train_parts)], np.float64)
-        t0 = time.perf_counter()
-        if cfg.stream_chunk_steps > 0:
-            state, mx = engine.round_streamed(
-                state, chunk_feed(trainset, train_parts, caps),
-                chunk_feed(valset, val_parts))
-        else:
-            state, mx = engine.round(
-                state, pack_all(trainset, train_parts, caps),
-                pack_all(valset, val_parts))
-        wall = time.perf_counter() - t0
+            for i, p in enumerate(tparts)], np.float64)
+        return dict(caps=caps, steps_run=steps_run,
+                    sizes=[len(p) for p in tparts],
+                    inputs=build_inputs(tparts, vparts, caps))
 
-        # --- metric assembly (trainer.py:49-171 semantics) --------------
-        # mx arrays: batch_losses [N, E, S], batch_mask [N, E, S],
-        # train/val loss/acc [N, E], avg_acc [N, E], global_* [N]
-        bl, bm = mx["batch_losses"], mx["batch_mask"]
-        epochs_local = bl.shape[1]
-        current_losses: list[float] = []
-        for e in range(epochs_local):
-            epoch_all_workers: list[float] = []
-            for i in range(n):
-                valid = bl[i, e][bm[i, e] > 0].tolist()
-                results["all_workers_losses"][i].extend(valid)
-                epoch_all_workers.extend(valid)
-            results["all_epochs_losses"].append(epoch_all_workers)
-            current_losses.extend(epoch_all_workers)
-        results["global_epoch_losses"].append(current_losses)
-        results["global_epoch_accuracies"].append(
-            mx["avg_acc"][0].tolist())
-        results["global_train_losses"].append(float(mx["global_train_loss"][0]))
-        results["global_train_accuracies"].append(float(mx["global_train_acc"][0]))
-        results["global_val_losses"].append(float(mx["global_val_loss"][0]))
-        results["global_val_accuracies"].append(float(mx["global_val_acc"][0]))
-        # rank-0 per-local-epoch curves (trainer.py:122-126)
-        results["worker_specific_train_losses"].extend(
-            mx["train_loss"][0].tolist())
-        results["worker_specific_train_accuracies"].extend(
-            mx["train_acc"][0].tolist())
-        results["worker_specific_val_losses"].extend(
-            mx["val_loss"][0].tolist())
-        results["worker_specific_val_accuracies"].extend(
-            mx["val_acc"][0].tolist())
+    walls_by_round: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+    next_wall_box = [start_epoch]  # next round whose wall the EMA consumes
 
-        if progress and jax.process_index() == 0:
-            # the reference's per-rank per-local-epoch report lines
-            # (trainer.py:109-110); all worker ranks share this process's
-            # stdout, so every rank's lines appear here.  tqdm.write keeps
-            # the live bar from garbling them.
-            say = pbar.write if pbar is not None else print
-            for r in range(n):
-                for e in range(epochs_local):
-                    say(f"Rank {r}, Global Epoch {global_epoch + 1}, "
-                        f"Local Epoch {e + 1}, "
-                        f"Loss: {mx['train_loss'][r, e]}, "
-                        f"Accuracy: {mx['train_acc'][r, e]}")
-                    say(f"Worker {r}, Global Epoch {global_epoch + 1}, "
-                        f"Validation Loss: {mx['val_loss'][r, e]:.4f}, "
-                        f"Validation Accuracy: {mx['val_acc'][r, e]:.2f}%")
-            if pbar is not None:  # trainer.py:174 postfix
-                pbar.set_postfix(
-                    loss=results["global_train_losses"][-1],
-                    accuracy=results["global_train_accuracies"][-1],
-                    wall=f"{wall:.1f}s")
-            else:
-                print(f"Global Epoch {global_epoch + 1}/{cfg.epochs_global}: "
-                      f"loss={results['global_train_losses'][-1]:.4f} "
-                      f"acc={results['global_train_accuracies'][-1]:.2f}% "
-                      f"val_loss={results['global_val_losses'][-1]:.4f} "
-                      f"val_acc={results['global_val_accuracies'][-1]:.2f}% "
-                      f"({wall:.1f}s)")
+    def consume_walls(upto: int):
+        """Blend measured (wall, steps) feedback for rounds < ``upto``
+        into the sec/batch EMA, exactly once each, in round order."""
+        nonlocal sec_per_batch
+        while next_wall_box[0] < upto and next_wall_box[0] in walls_by_round:
+            ww, steps = walls_by_round.pop(next_wall_box[0])
+            measured_spb = ww / np.maximum(steps, 1.0)
+            sec_per_batch = 0.5 * sec_per_batch + 0.5 * measured_spb
+            next_wall_box[0] += 1
 
-        # --- measured straggler feedback (trainer.py:112-119, 179-188) ---
-        # The reference updates its view of worker speed from the measured
-        # wall time of every round, not just the initial probe.  Blend the
-        # measured per-worker sec/batch into the estimate (EMA), so a
-        # worker that slows down mid-run gets a smaller step cap and a
-        # re-balanced shard from the NEXT round on.
-        if simulated_round_durations is not None:
-            worker_walls = np.asarray(
-                simulated_round_durations(global_epoch), np.float64)
-        else:
-            # total steps this round = epochs_local x (train steps + val
-            # steps); attribute the wall to train steps proportionally
-            worker_walls = _measured_worker_walls(wall, n) / max(
-                cfg.epochs_local, 1)
-        measured_spb = worker_walls / np.maximum(steps_run, 1.0)
-        sec_per_batch = 0.5 * sec_per_batch + 0.5 * measured_spb
+    def prepare_next(cur_epoch: int, cur_steps_run: np.ndarray):
+        """Re-partition + pack round ``cur_epoch + 1``.
 
-        # --- re-partition (trainer.py:179-188) ---------------------------
-        # Per-worker round durations.  A lockstep SPMD round has one wall
-        # clock per process, so the reference's per-worker epoch wall time
-        # is modeled as (measured sec/batch)_i x (steps run)_i — the same
-        # adaptive feedback signal: at equilibrium all products equalize,
-        # i.e. shard sizes settle inversely proportional to measured speed.
-        round_durations = sec_per_batch * np.maximum(steps_run, 1.0)
+        Runs while round ``cur_epoch`` may still be computing, so the
+        straggler feedback (trainer.py:112-119, 179-188 semantics)
+        consumes measured walls only through round ``cur_epoch - 1`` —
+        the one-round-delayed EMA.  The per-worker round durations are
+        modeled as (EMA sec/batch)_i x (steps run)_i of the CURRENT round
+        (host-known at dispatch time): at equilibrium the products
+        equalize, i.e. shard sizes settle inversely proportional to
+        measured speed, one round later than the fully-serial reference."""
+        nonlocal train_parts, val_parts
+        consume_walls(upto=cur_epoch)
+        round_durations = sec_per_batch * np.maximum(cur_steps_run, 1.0)
         new_ratios = efficiency_ratios(round_durations, cfg.proportionality)
         replace = cfg.data_mode == "disbalanced"
         train_parts = [
@@ -600,13 +603,132 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                 skew_repartition(valset.labels, p, fixed_classes[i],
                                  cfg.fixed_ratio, rng)
                 for i, p in enumerate(val_parts)]
+        return make_prep(train_parts, val_parts)
 
-        if (cfg.checkpoint_dir and cfg.checkpoint_every
-                and (global_epoch + 1) % cfg.checkpoint_every == 0):
-            # every process enters (the save gathers collectively);
-            # only process 0 writes the file
-            ckpt_lib.save_checkpoint(cfg.checkpoint_dir, state,
-                                     global_epoch + 1)
+    def report_progress(mx, global_epoch: int, wall: float):
+        if not (progress and jax.process_index() == 0):
+            return
+        # the reference's per-rank per-local-epoch report lines
+        # (trainer.py:109-110); all worker ranks share this process's
+        # stdout, so every rank's lines appear here.  tqdm.write keeps
+        # the live bar from garbling them.  In the overlapped pipeline
+        # this runs on the metric worker thread (tqdm locks internally).
+        say = pbar.write if pbar is not None else print
+        epochs_local = np.asarray(mx["train_loss"]).shape[1]
+        for r in range(n):
+            for e in range(epochs_local):
+                say(f"Rank {r}, Global Epoch {global_epoch + 1}, "
+                    f"Local Epoch {e + 1}, "
+                    f"Loss: {mx['train_loss'][r, e]}, "
+                    f"Accuracy: {mx['train_acc'][r, e]}")
+                say(f"Worker {r}, Global Epoch {global_epoch + 1}, "
+                    f"Validation Loss: {mx['val_loss'][r, e]:.4f}, "
+                    f"Validation Accuracy: {mx['val_acc'][r, e]:.2f}%")
+        if pbar is not None:  # trainer.py:174 postfix
+            pbar.set_postfix(
+                loss=results["global_train_losses"][-1],
+                accuracy=results["global_train_accuracies"][-1],
+                wall=f"{wall:.1f}s")
+        else:
+            print(f"Global Epoch {global_epoch + 1}/{cfg.epochs_global}: "
+                  f"loss={results['global_train_losses'][-1]:.4f} "
+                  f"acc={results['global_train_accuracies'][-1]:.2f}% "
+                  f"val_loss={results['global_val_losses'][-1]:.4f} "
+                  f"val_acc={results['global_val_accuracies'][-1]:.2f}% "
+                  f"({wall:.1f}s)")
+
+    def metrics_job(handle, global_epoch: int, t_dispatch: float,
+                    timing: dict):
+        """Fetch + vectorized assembly of one round's metrics; the
+        overlapped pipeline runs this on the worker thread while the next
+        round computes (in that mode fetch_ms includes the tail of the
+        round's own device time — it is hidden wall, not host gap)."""
+        t0 = time.perf_counter()
+        mx = engine.finish_metrics(handle)
+        timing["fetch_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+        t0 = time.perf_counter()
+        _assemble_round_metrics(results, mx, n)
+        timing["assemble_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
+        report_progress(mx, global_epoch, time.perf_counter() - t_dispatch)
+
+    executor = (ThreadPoolExecutor(max_workers=1,
+                                   thread_name_prefix="round-metrics")
+                if overlap else None)
+    pending: list = []
+    # no pack/stage when no rounds will run (e.g. resuming a finished run)
+    prep = (make_prep(train_parts, val_parts)
+            if start_epoch < cfg.epochs_global else None)
+    t_ready = None
+    try:
+        for global_epoch in epoch_iter:
+            # fail fast on metric-worker errors: a fetch/assembly failure
+            # from an earlier round must abort the run within one round,
+            # not after every remaining round has burned device time
+            while pending and pending[0].done():
+                pending.pop(0).result()
+            results["step_caps"].append(list(prep["caps"]))
+            results["shard_sizes"].append(list(prep["sizes"]))
+            timing: dict[str, Any] = {}
+            results["round_timings"].append(timing)
+            t_disp = time.perf_counter()
+            if t_ready is not None:
+                # host time the device sat idle between the previous round
+                # finishing and this round's dispatch — the round gap the
+                # overlap exists to close (bench.py round_gap entry)
+                results["round_timings"][-2]["gap_ms"] = round(
+                    (t_disp - t_ready) * 1e3, 3)
+            if streaming:
+                state, handle = engine.round_streamed_start(
+                    state, *prep["inputs"])
+            else:
+                state, handle = engine.round_start(state, *prep["inputs"])
+            timing["stage_ms"] = round(
+                (time.perf_counter() - t_disp) * 1e3, 3)
+            cur_steps_run = prep["steps_run"]
+            if overlap:
+                pending.append(executor.submit(
+                    metrics_job, handle, global_epoch, t_disp, timing))
+                if global_epoch + 1 < cfg.epochs_global:
+                    t0 = time.perf_counter()
+                    prep = prepare_next(global_epoch, cur_steps_run)
+                    timing["prep_ms"] = round(
+                        (time.perf_counter() - t0) * 1e3, 3)
+            state = engine.round_wait(state)
+            t_ready = time.perf_counter()
+            wall = t_ready - t_disp
+            timing["compute_ms"] = round(wall * 1e3, 3)
+            # record the measured wall for DELAYED consumption: the EMA
+            # blends it in when round global_epoch + 2 is being prepared
+            if simulated_round_durations is not None:
+                worker_walls = np.asarray(
+                    simulated_round_durations(global_epoch), np.float64)
+            else:
+                # total steps this round = epochs_local x (train + val
+                # steps); attribute the wall to train steps proportionally
+                worker_walls = _measured_worker_walls(wall, n) / max(
+                    cfg.epochs_local, 1)
+            walls_by_round[global_epoch] = (worker_walls, cur_steps_run)
+            if not overlap:
+                metrics_job(handle, global_epoch, t_disp, timing)
+                if global_epoch + 1 < cfg.epochs_global:
+                    t0 = time.perf_counter()
+                    prep = prepare_next(global_epoch, cur_steps_run)
+                    timing["prep_ms"] = round(
+                        (time.perf_counter() - t0) * 1e3, 3)
+
+            if (cfg.checkpoint_dir and cfg.checkpoint_every
+                    and (global_epoch + 1) % cfg.checkpoint_every == 0):
+                # every process enters (the save gathers collectively);
+                # only process 0 writes the file.  The state is ready and
+                # the next round is NOT yet dispatched, so the save reads
+                # the buffers before donation can invalidate them.
+                ckpt_lib.save_checkpoint(cfg.checkpoint_dir, state,
+                                         global_epoch + 1)
+    finally:
+        if executor is not None:
+            for fut in pending:
+                fut.result()   # propagate worker-thread failures loudly
+            executor.shutdown(wait=True)
 
     if pbar is not None:
         pbar.close()

@@ -33,7 +33,13 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..compat import shape_dtype_struct, typeof
 from .attention import NEG_INF
+
+# JAX-version compat: the TPU compiler-params container was renamed from
+# TPUCompilerParams (<= 0.4.x) to CompilerParams; same kwargs either way
+_CompilerParams = (getattr(pltpu, "CompilerParams", None)
+                   or pltpu.TPUCompilerParams)
 
 BQ = 1024  # query block (MXU-aligned)
 BK = 1024  # key/value block
@@ -140,16 +146,16 @@ def _flash_forward(q, k, v, causal=False, with_lse=False):
     qt, kt, vt = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
     # under shard_map's varying-manual-axes typing the out aval must carry
     # the same mesh-varying set as the inputs
-    vma = getattr(jax.typeof(qt), "vma", None)
+    vma = getattr(typeof(qt), "vma", None)
     kw = dict(scale=scale, nk=lk // bk, bq=bq, bk=bk, causal=causal)
     kernel = (functools.partial(_flash_kernel, **kw) if with_lse
               else functools.partial(_fwd_kernel_nolse, **kw))
     o_spec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, i, j: (b_, h_, i, 0),
                           memory_space=pltpu.VMEM)
-    out_shape = [jax.ShapeDtypeStruct(qt.shape, q.dtype, vma=vma)]
+    out_shape = [shape_dtype_struct(qt.shape, q.dtype, vma=vma)]
     out_specs = [o_spec]
     if with_lse:
-        out_shape.append(jax.ShapeDtypeStruct((b, h, lq, LANES), jnp.float32,
+        out_shape.append(shape_dtype_struct((b, h, lq, LANES), jnp.float32,
                                               vma=vma))
         out_specs.append(pl.BlockSpec(
             (1, 1, bq, LANES), lambda b_, h_, i, j: (b_, h_, i, 0),
@@ -173,7 +179,7 @@ def _flash_forward(q, k, v, causal=False, with_lse=False):
             pltpu.VMEM((bq, 1), jnp.float32),    # running denom l
             pltpu.VMEM((bq, d), jnp.float32),    # output accumulator
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=_interpret(),
@@ -368,7 +374,7 @@ def _flash_backward_fused(q, k, v, o, lse, g, causal):
     delta = jnp.einsum("bhld,bhld->bhl", gt.astype(jnp.float32),
                        ot.astype(jnp.float32))
     delta = jnp.broadcast_to(delta[..., None], (b, h, lq, LANES))
-    vma = getattr(jax.typeof(qt), "vma", None)
+    vma = getattr(typeof(qt), "vma", None)
     rowT = lambda m: pl.BlockSpec(
         (1, 1, bq, m),
         lambda b_, g_, j, it: (b_, g_ * rep + it // ni, it % ni, 0),
@@ -380,16 +386,16 @@ def _flash_backward_fused(q, k, v, o, lse, g, causal):
         (1, 1, 1, bq, d),
         lambda b_, g_, j, it: (b_, g_ * rep + it // ni, j, it % ni, 0),
         memory_space=pltpu.VMEM)
-    params = pltpu.CompilerParams(
+    params = _CompilerParams(
         dimension_semantics=("parallel", "parallel", "parallel",
                              "arbitrary"))
     dqp, dkt, dvt = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, scale=scale, ni=ni, rep=rep,
                           bq=bq, bk=bk, causal=causal),
-        out_shape=[jax.ShapeDtypeStruct((b, h, nj, lq, d), jnp.float32,
+        out_shape=[shape_dtype_struct((b, h, nj, lq, d), jnp.float32,
                                         vma=vma),
-                   jax.ShapeDtypeStruct(kt.shape, k.dtype, vma=vma),
-                   jax.ShapeDtypeStruct(vt.shape, v.dtype, vma=vma)],
+                   shape_dtype_struct(kt.shape, k.dtype, vma=vma),
+                   shape_dtype_struct(vt.shape, v.dtype, vma=vma)],
         grid=(b, kv, nj, ni * rep),
         in_specs=[rowT(d), colT(d), colT(d), rowT(d), rowT(LANES),
                   rowT(LANES)],
@@ -419,7 +425,7 @@ def _flash_backward(q, k, v, o, lse, g, causal):
     delta = jnp.einsum("bhld,bhld->bhl", gt.astype(jnp.float32),
                        ot.astype(jnp.float32))
     delta = jnp.broadcast_to(delta[..., None], (b, h, lq, LANES))
-    vma = getattr(jax.typeof(qt), "vma", None)
+    vma = getattr(typeof(qt), "vma", None)
     row = lambda m: pl.BlockSpec((1, 1, bq, m),
                                  lambda b_, h_, i, j: (b_, h_, i, 0),
                                  memory_space=pltpu.VMEM)
@@ -436,13 +442,13 @@ def _flash_backward(q, k, v, o, lse, g, causal):
     colT = lambda m: pl.BlockSpec((1, 1, bk, m),
                                   lambda b_, g, j, it: (b_, g, j, 0),
                                   memory_space=pltpu.VMEM)
-    params = pltpu.CompilerParams(
+    params = _CompilerParams(
         dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
 
     dqt = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, nk=lk // bk,
                           bq=bq, bk=bk, causal=causal),
-        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype, vma=vma),
+        out_shape=shape_dtype_struct(qt.shape, q.dtype, vma=vma),
         grid=(b, h, ni, lk // bk),
         in_specs=[row(d), col(d), col(d), row(d), row(LANES), row(LANES)],
         out_specs=row(d),
@@ -453,8 +459,8 @@ def _flash_backward(q, k, v, o, lse, g, causal):
     dkt, dvt = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, ni=ni, rep=rep,
                           bq=bq, bk=bk, causal=causal),
-        out_shape=[jax.ShapeDtypeStruct(kt.shape, k.dtype, vma=vma),
-                   jax.ShapeDtypeStruct(vt.shape, v.dtype, vma=vma)],
+        out_shape=[shape_dtype_struct(kt.shape, k.dtype, vma=vma),
+                   shape_dtype_struct(vt.shape, v.dtype, vma=vma)],
         grid=(b, kv, lk // bk, ni * rep),
         in_specs=[rowT(d), colT(d), colT(d), rowT(d), rowT(LANES),
                   rowT(LANES)],
@@ -540,7 +546,7 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     # the Pallas HLO interpreter (CPU test path) cannot lower kernels whose
     # operands are mesh-varying inside shard_map; the unit tests cover the
     # kernel outside shard_map and the real path compiles on TPU
-    in_shard_map = bool(getattr(jax.typeof(q), "vma", None))
+    in_shard_map = bool(getattr(typeof(q), "vma", None))
     if mask is not None:
         _log_fallback("arbitrary masks are not tiled (use causal=True for "
                       "autoregressive masking)", q)

@@ -42,6 +42,8 @@ TPU-first design (not a translation):
 from __future__ import annotations
 
 import logging
+import queue
+import threading
 from typing import Any, Callable
 
 import jax
@@ -53,6 +55,8 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import comms
+from .compat import (LEGACY_SHARD_MAP, axis_size, optimization_barrier,
+                     pcast, shard_map, typeof)
 from .config import Config
 from .data.augment import augment_batch
 from .mesh import DATA_AXIS
@@ -199,11 +203,87 @@ def _zeros_like_varying(tree: PyTree) -> PyTree:
     axis."""
     def z(x):
         zz = jnp.zeros_like(x)
-        want = set(getattr(jax.typeof(x), "vma", ()))
-        have = set(getattr(jax.typeof(zz), "vma", ()))
+        want = set(getattr(typeof(x), "vma", ()))
+        have = set(getattr(typeof(zz), "vma", ()))
         missing = tuple(sorted(want - have))
-        return lax.pcast(zz, missing, to="varying") if missing else zz
+        return pcast(zz, missing, to="varying") if missing else zz
     return jax.tree_util.tree_map(z, tree)
+
+
+class ChunkStager:
+    """Bounded producer thread for the streamed round's input pipeline.
+
+    Wraps a generator of host windows: the producer packs the next
+    window(s) and stages them onto device (``stage_fn``) while the
+    consumer's current chunk computes.  ``depth`` bounds the number of
+    STAGED device-resident windows ahead of the consumer — ``depth=2`` is
+    classic double buffering (one window computing, one staged on the
+    alternate buffer, one being packed by the producer).  Generator /
+    staging exceptions re-raise at the consumer's next pull.
+
+    A consumer that bails mid-round must ``close()`` the stager (the
+    round loop does, via try/except): close stops the producer and drains
+    the queue so the staged device buffers are released instead of being
+    pinned by a parked daemon thread for the rest of the process.
+    """
+
+    _DONE = object()
+
+    def __init__(self, gen, stage_fn, depth: int = 2):
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, int(depth)))
+        self._err: BaseException | None = None
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._produce,
+                                   args=(gen, stage_fn), daemon=True,
+                                   name="chunk-stager")
+        self._t.start()
+
+    def _produce(self, gen, stage_fn):
+        try:
+            for item in gen:
+                staged = stage_fn(item)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(staged, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stop.is_set():
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised at consumer
+            self._err = e
+        finally:
+            # the sentinel uses the same stop-aware bounded put: block
+            # while the consumer drains, give up only once close()d
+            while not self._stop.is_set():
+                try:
+                    self._q.put(self._DONE, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    def close(self):
+        """Stop the producer and drop any staged-but-unconsumed windows
+        (releases their device buffers).  Idempotent."""
+        self._stop.set()
+        # drain, let the producer observe the stop (its put attempts are
+        # 0.1 s-bounded), then drain whatever its in-flight put landed
+        for _ in range(2):
+            while True:
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    break
+            self._t.join(timeout=1.0)
+
+    def __iter__(self):
+        while True:
+            item = self._q.get()
+            if item is self._DONE:
+                if self._err is not None:
+                    raise self._err
+                return
+            yield item
 
 
 class LocalSGDEngine:
@@ -251,6 +331,28 @@ class LocalSGDEngine:
                         else None)
         self.param_specs = None      # set by init_state
         self._sspec = None           # full TrainState spec tree (TP only)
+        # inner (non-worker) mesh axes of size > 1 — the axes legacy
+        # shard_map's replication certifier may need help with
+        self._inner_axes = tuple(
+            a for a in mesh.axis_names
+            if a != DATA_AXIS and int(mesh.shape[a]) > 1)
+        # Legacy-JAX check_rep choice per engine config.  TP/EP/PP need
+        # the check_rep=True rewrite (it auto-inserts the gradient psums
+        # for replicated params).  Pure SP (optionally x FSDP) does every
+        # cross-device reduction MANUALLY, and legacy check_rep=True has
+        # a scan-transpose bug under the ring-attention backward
+        # ("mismatched replication types"), so those configs run
+        # check_rep=False — gradient-exact, verified against dense.
+        # None = modern JAX, pass nothing.
+        if not LEGACY_SHARD_MAP:
+            self._check_rep = None
+        else:
+            from .mesh import EXPERT_AXIS, MODEL_AXIS
+            needs_rewrite = (int(mesh.shape.get(MODEL_AXIS, 1)) > 1
+                             or int(mesh.shape.get(EXPERT_AXIS, 1)) > 1
+                             or int(mesh.shape.get(PIPE_AXIS, 1)) > 1)
+            self._check_rep = not (self.seq_axis is not None
+                                   and not needs_rewrite)
         # torch.optim.Adam defaults (betas 0.9/0.999, eps 1e-8); LR applied
         # outside so StepLR can drive it per local epoch.
         self.tx = optax.scale_by_adam(b1=0.9, b2=0.999, eps=1e-8)
@@ -371,6 +473,39 @@ class LocalSGDEngine:
     # ------------------------------------------------------------------
     # The round program
     # ------------------------------------------------------------------
+    def _certify_replication(self, tree, specs):
+        """Re-certify out-spec-claimed replication for legacy shard_map.
+
+        Legacy JAX's ``check_rep`` machinery cannot always INFER the
+        replication an out_spec claims (custom-vjp calls in the round
+        program make its tracking conservative), which rejects otherwise
+        correct programs at trace time.  An explicit all-reduce over each
+        leaf's claimed-replicated inner axes is the identity on the
+        already-replicated values (pmean for floats, pmax for
+        integer/uint leaves — no division) and re-establishes the
+        certificate.  Modern JAX proves replication structurally through
+        vma types; this is a no-op there and on data-only meshes."""
+        if (not LEGACY_SHARD_MAP or not self._inner_axes
+                or self._check_rep is False):  # False = nothing to certify
+            return tree
+
+        def cert(spec, subtree):
+            used = {a for part in spec if part is not None
+                    for a in (part if isinstance(part, tuple) else (part,))}
+            missing = tuple(a for a in self._inner_axes if a not in used)
+            if not missing:
+                return subtree
+            red = lambda x: (lax.pmean(x, missing)
+                             if jnp.issubdtype(x.dtype, jnp.inexact)
+                             else lax.pmax(x, missing))
+            return jax.tree_util.tree_map(red, subtree)
+
+        from jax.sharding import PartitionSpec as _P
+        if isinstance(specs, _P):
+            return cert(specs, tree)
+        return jax.tree_util.tree_map(cert, specs, tree,
+                                      is_leaf=lambda z: isinstance(z, _P))
+
     def _grad_global_norm(self, grads):
         """Global L2 norm of a gradient pytree whose leaves may be
         physically sharded over inner mesh axes (TP/PP/EP param specs):
@@ -460,7 +595,7 @@ class LocalSGDEngine:
             # different per-device orders deadlock the unpinned XLA:CPU
             # rendezvous (the same race the standard path barriers at
             # its metrics psum; free on TPU)
-            emb = lax.optimization_barrier((emb, denom))[0]
+            emb = optimization_barrier((emb, denom))[0]
         xs = emb.reshape(mnum, b // mnum, *emb.shape[1:])
         denom = jnp.maximum(denom, 1.0)  # data-derived: known pre-schedule
         stage_params = params["layers"]
@@ -547,7 +682,7 @@ class LocalSGDEngine:
             # SP x PP stress runs; 40 s timeout then SIGABRT).  Routing
             # ``w`` through a barrier with ``ce`` (which depends on the
             # model output) serializes them; free on TPU.
-            w = lax.optimization_barrier((w, ce))[0]
+            w = optimization_barrier((w, ce))[0]
             # the batch is partial on this device: under seq parallelism it
             # holds one chunk of every sequence, under FSDP a slice of the
             # worker's batch (composable — psum over both).  The loss is
@@ -583,7 +718,7 @@ class LocalSGDEngine:
                 # FSDP x MoE, MoE x SP)
                 denom_aux = 1.0
                 for ax in part_aux:
-                    denom_aux = denom_aux * lax.axis_size(ax)
+                    denom_aux = denom_aux * axis_size(ax)
                 a = a / denom_aux
             loss = loss + self.cfg.moe_aux_weight * a
         new_bs = mut.get("batch_stats", batch_stats)
@@ -753,14 +888,23 @@ class LocalSGDEngine:
             expand = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
             new_state, metrics = per_worker(
                 squeeze(state), *map(lambda a: a[0], (x, y, m, xv, yv, mv)))
+            new_state = self._certify_replication(new_state, sspec)
+            metrics = self._certify_replication(metrics, self._spec)
             return expand(new_state), expand(metrics)
 
         sspec = self._sspec if self._sspec is not None else self._spec
         in_specs = (sspec,) + self._pack_specs(shapes_key) * 2
-        fn = jax.shard_map(
+        fn = shard_map(
             stacked, mesh=self.mesh,
-            in_specs=in_specs, out_specs=(sspec, self._spec))
+            in_specs=in_specs, out_specs=(sspec, self._spec),
+            **self._sm_kwargs())
         return jax.jit(fn, donate_argnums=(0,))
+
+    def _sm_kwargs(self) -> dict:
+        """Extra shard_map kwargs: the legacy check_rep choice (see
+        __init__); nothing on modern JAX."""
+        return {} if self._check_rep is None else \
+            {"check_rep": self._check_rep}
 
     def _pack_specs(self, shapes_key=None):
         """(x, y, m) PartitionSpecs for one pack.  Token tasks under
@@ -784,24 +928,66 @@ class LocalSGDEngine:
         return (self._sspec.params, self._sspec.batch_stats,
                 self._sspec.opt_state, self._spec, self._sspec.params)
 
-    def round(self, state: TrainState, train_pack, val_pack):
-        """Run one global epoch.  Packs are numpy stacks
-        (x [N,S,B,...], y [N,S,B], m [N,S,B])."""
+    def stage_pack(self, train_pack, val_pack):
+        """Stage numpy round packs onto device ahead of dispatch.
+
+        The overlapped driver calls this from its prepare step while the
+        PREVIOUS round is still computing, so the host->device transfer
+        of round r+1's inputs rides under round r's device time;
+        ``round_start`` accepts the staged arrays as-is."""
+        xs, ys, ms = self._pack_specs()
+        put = self._put
+        stage = lambda p: (put(p[0], xs), put(p[1], ys), put(p[2], ms))
+        return stage(train_pack), stage(val_pack)
+
+    def round_start(self, state: TrainState, train_pack, val_pack):
+        """Stage (if not already staged) + dispatch one global epoch
+        WITHOUT blocking on it.
+
+        Packs are numpy stacks (x [N,S,B,...], y [N,S,B], m [N,S,B]) or
+        the device triples ``stage_pack`` returns.  Returns
+        ``(new_state, handle)``: ``new_state`` is the
+        asynchronously-computing round output (the input ``state``'s
+        buffers are DONATED to the round program — the caller must not
+        touch them again), and ``handle`` feeds ``finish_metrics`` (from
+        any thread) to obtain the round's host metric arrays.  Callers
+        must ``round_wait`` before dispatching the next round — at most
+        one round program in flight (1-core CPU hosts deadlock on
+        pipelined collective rendezvous)."""
+        if not isinstance(train_pack[0], jax.Array):
+            train_pack, val_pack = self.stage_pack(train_pack, val_pack)
         x, y, m = train_pack
         xv, yv, mv = val_pack
         key = (tuple(x.shape[1:]), tuple(xv.shape[1:]))
         if key not in self._round_cache:
             log.info("compiling round program for shapes %s", key)
             self._round_cache[key] = self._build_round(key)
-        xs, ys, ms = self._pack_specs()
-        put = self._put
         new_state, metrics = self._round_cache[key](
-            state, put(x, xs), put(y, ys), put(m, ms),
-            put(xv, xs), put(yv, ys), put(mv, ms))
-        # block: keeps at most one collective execution in flight (required
-        # on 1-core CPU hosts where pipelined rendezvous can deadlock)
-        new_state = jax.block_until_ready(new_state)
-        return new_state, self._fetch(metrics)
+            state, x, y, m, xv, yv, mv)
+        return new_state, ("packed", metrics)
+
+    @staticmethod
+    def round_wait(new_state: TrainState) -> TrainState:
+        """Block until a dispatched round's state is materialized — the
+        barrier that keeps at most one round program in flight."""
+        return jax.block_until_ready(new_state)
+
+    def finish_metrics(self, handle) -> dict:
+        """Fetch + assemble a dispatched round's host metrics.
+
+        Blocks until the round's metric buffers are computed; safe to call
+        from a worker thread while the NEXT round is already running —
+        the overlapped driver pipeline does exactly that."""
+        if handle[0] == "packed":
+            return self._fetch(handle[1])
+        _, per_epoch, agg_grad_norm = handle
+        return self._assemble_streamed(per_epoch, agg_grad_norm)
+
+    def round(self, state: TrainState, train_pack, val_pack):
+        """Serial convenience wrapper: dispatch, block, fetch."""
+        new_state, handle = self.round_start(state, train_pack, val_pack)
+        new_state = self.round_wait(new_state)
+        return new_state, self.finish_metrics(handle)
 
     # ------------------------------------------------------------------
     # Streamed rounds: per-chunk host->device feeding (ImageNet scale)
@@ -823,11 +1009,16 @@ class LocalSGDEngine:
             ex = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
             unstacked = [a if s == P() else sq(a)
                          for a, s in zip(args, in_specs)]
-            return ex(per_worker(*unstacked))
+            out = per_worker(*unstacked)
+            out = self._certify_replication(out, out_specs or self._spec)
+            return ex(out)
 
-        fn = jax.shard_map(stacked, mesh=self.mesh, in_specs=tuple(in_specs),
-                           out_specs=out_specs or self._spec)
-        return jax.jit(fn, donate_argnums=(0,) if donate else ())
+        fn = shard_map(stacked, mesh=self.mesh, in_specs=tuple(in_specs),
+                       out_specs=out_specs or self._spec,
+                       **self._sm_kwargs())
+        if donate is True:
+            donate = (0,)
+        return jax.jit(fn, donate_argnums=donate or ())
 
     def _build_chunk_train(self, shapes_key):
         augment = self.cfg.augment and len(shapes_key) == 5  # [C,B,H,W,Ch]
@@ -882,22 +1073,45 @@ class LocalSGDEngine:
             return params, agg_grad_norm
 
         pspec = self._sspec.params if self._sspec is not None else self._spec
+        # params and last-grads are both last-use at the sync point: donate
+        # them so the once-per-round parameter sync updates in place
+        # instead of copying every replica
         return self._wrap_stacked(per_worker, [pspec, pspec],
-                                  out_specs=(pspec, self._spec))
+                                  out_specs=(pspec, self._spec),
+                                  donate=(0, 1))
 
-    def round_streamed(self, state: TrainState, train_chunks, val_chunks):
-        """One global epoch with streamed input.
+    def _staged_chunks(self, gen):
+        """Iterator of device-staged (x, y, m) chunk triples.
+
+        With ``cfg.stream_prefetch > 0`` a bounded producer thread
+        (``ChunkStager``) packs + stages up to that many windows ahead
+        onto alternating device buffers while the current chunk computes;
+        0 stages synchronously (the serial twin)."""
+        xs_spec, ys_spec, ms_spec = self._pack_specs()
+        put = self._put
+
+        def stage(chunk):
+            x, y, m = chunk
+            return put(x, xs_spec), put(y, ys_spec), put(m, ms_spec)
+
+        if self.cfg.stream_prefetch > 0:
+            return ChunkStager(gen, stage, depth=self.cfg.stream_prefetch)
+        return map(stage, gen)
+
+    def round_streamed_start(self, state: TrainState, train_chunks,
+                             val_chunks):
+        """Dispatch one streamed global epoch; metric fetch is deferred.
 
         ``train_chunks(epoch)`` / ``val_chunks(epoch)`` return an iterator
         of fixed-shape numpy (x [N,C,B,...], y [N,C,B,...], m [N,C,B])
-        chunks for that local epoch.  Returns (new_state, mx) with the same
-        metric structure as ``round`` — numerics match the whole-round
-        program exactly (same step bodies, same RNG stream).
+        chunks for that local epoch.  Returns ``(new_state, handle)``
+        exactly like ``round_start``: the chunk programs and the sync are
+        dispatched (with a per-local-epoch in-flight barrier), but the
+        O(metrics) device->host fetch + numpy assembly are deferred to
+        ``finish_metrics`` so the driver can run them on a worker thread
+        while the next round computes.
         """
         cfg = self.cfg
-        n = self.n_workers
-        xs_spec, ys_spec, ms_spec = self._pack_specs()
-        put = self._put
         zeros_like = jax.jit(
             lambda p: jax.tree_util.tree_map(jnp.zeros_like, p))
 
@@ -915,26 +1129,32 @@ class LocalSGDEngine:
             if e > 0:
                 inner = inner[:4] + (zeros_like(inner[0]),)
             t_ys = []
-            for (x, y, m) in train_chunks(e):
-                key = ("ct", tuple(x.shape[1:]))
-                if key not in self._round_cache:
-                    log.info("compiling chunk-train program for %s", key)
-                    self._round_cache[key] = self._build_chunk_train(
-                        tuple(x.shape[1:]))
-                inner, ys = self._round_cache[key](
-                    inner, lr, put(x, xs_spec), put(y, ys_spec),
-                    put(m, ms_spec))
-                t_ys.append(ys)
-            v_sums = []
-            for (x, y, m) in val_chunks(e):
-                key = ("ce", tuple(x.shape[1:]))
-                if key not in self._round_cache:
-                    log.info("compiling chunk-eval program for %s", key)
-                    self._round_cache[key] = self._build_chunk_eval(
-                        tuple(x.shape[1:]))
-                v_sums.append(self._round_cache[key](
-                    inner[0], inner[1], put(x, xs_spec), put(y, ys_spec),
-                    put(m, ms_spec)))
+            feed = self._staged_chunks(train_chunks(e))
+            try:
+                for (x, y, m) in feed:
+                    key = ("ct", tuple(x.shape[1:]))
+                    if key not in self._round_cache:
+                        log.info("compiling chunk-train program for %s", key)
+                        self._round_cache[key] = self._build_chunk_train(
+                            tuple(x.shape[1:]))
+                    inner, ys = self._round_cache[key](inner, lr, x, y, m)
+                    t_ys.append(ys)
+                v_sums = []
+                feed = self._staged_chunks(val_chunks(e))
+                for (x, y, m) in feed:
+                    key = ("ce", tuple(x.shape[1:]))
+                    if key not in self._round_cache:
+                        log.info("compiling chunk-eval program for %s", key)
+                        self._round_cache[key] = self._build_chunk_eval(
+                            tuple(x.shape[1:]))
+                    v_sums.append(self._round_cache[key](
+                        inner[0], inner[1], x, y, m))
+            except BaseException:
+                # consumer bailed mid-round (e.g. a compile error): stop
+                # the producer and release its staged device buffers
+                if isinstance(feed, ChunkStager):
+                    feed.close()
+                raise
             # one fetch barrier per epoch keeps at most one epoch's worth of
             # dispatch in flight (see the 1-core-CPU rendezvous note above)
             jax.block_until_ready(inner[0])
@@ -944,14 +1164,17 @@ class LocalSGDEngine:
         if "sync" not in self._round_cache:
             self._round_cache["sync"] = self._build_sync()
         params, agg_grad_norm = self._round_cache["sync"](params, last_grads)
-        params = jax.block_until_ready(params)
 
         new_state = TrainState(
             params=params, batch_stats=batch_stats, opt_state=opt_state,
             lr_epoch=state.lr_epoch + cfg.epochs_local, rng=rng)
+        return new_state, ("streamed", per_epoch, agg_grad_norm)
 
-        # --- host metric assembly (same structure as `round`) -------------
-        E = cfg.epochs_local
+    def _assemble_streamed(self, per_epoch, agg_grad_norm) -> dict:
+        """Fetch + assemble a streamed round's metrics into the same mx
+        structure ``round`` returns (thread-safe; blocks on the fetches)."""
+        E = self.cfg.epochs_local
+        n = self.n_workers
         losses, corrects, totals, vls, vcs, vws = ([] for _ in range(6))
         for t_ys, v_sums in per_epoch:
             l, c, t = zip(*(self._fetch(ys) for ys in t_ys))
@@ -972,7 +1195,7 @@ class LocalSGDEngine:
         val_loss = np.stack(vls, 1) / vw_arr
         val_acc = 100.0 * np.stack(vcs, 1) / vw_arr
         tile = lambda v: np.broadcast_to(np.asarray(v, np.float32), (n,))
-        mx = dict(
+        return dict(
             batch_losses=losses, batch_mask=real,
             train_loss=train_loss, train_acc=train_acc,
             val_loss=val_loss, val_acc=val_acc,
@@ -983,4 +1206,12 @@ class LocalSGDEngine:
             global_val_loss=tile(val_loss.mean()),
             global_val_acc=tile(val_acc.mean()),
         )
-        return new_state, mx
+
+    def round_streamed(self, state: TrainState, train_chunks, val_chunks):
+        """Serial convenience wrapper around the streamed round: dispatch,
+        block, fetch.  Numerics match the whole-round program exactly
+        (same step bodies, same RNG stream)."""
+        new_state, handle = self.round_streamed_start(
+            state, train_chunks, val_chunks)
+        new_state = self.round_wait(new_state)
+        return new_state, self.finish_metrics(handle)

@@ -34,6 +34,15 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# JAX-version compat: publishes jax.shard_map / jax.typeof / lax.pcast /
+# lax.axis_size shims on legacy runtimes (e.g. 0.4.x) before any test
+# references them directly
+from learning_deep_neural_network_in_distributed_computing_environment_tpu import (  # noqa: E402
+    compat as _compat,
+)
+
+_compat.install()
+
 import pytest  # noqa: E402
 
 # --- quick tier ----------------------------------------------------------

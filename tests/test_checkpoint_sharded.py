@@ -27,6 +27,7 @@ def _kw(tmp_path, **extra):
     return kw
 
 
+@pytest.mark.slow
 class TestShardedResume:
     def test_fsdp_state_roundtrip_exact(self, devices, tmp_path):
         """save -> restore of a ZeRO-3-sharded TrainState is bit-exact and

@@ -79,8 +79,11 @@ class TestEndToEnd:
     def test_midrun_slowdown_shrinks_next_cap(self, mesh8):
         # VERDICT r1 'Next' #8: the straggler budget must react to MEASURED
         # round wall time, not just the initial probe.  Worker walls are
-        # uniform in round 0; in round 1 every worker reports a 100x wall.
-        # The cap for round 2 must shrink accordingly.
+        # uniform in round 0; from round 1 on every worker reports a 100x
+        # wall.  Under the overlapped pipeline's DELAYED EMA (round r+1 is
+        # packed while round r still runs, so the freshest wall it can
+        # consume is round r-1's) the reaction lands one round later:
+        # round 3's cap shrinks from round 1's measured wall.
         sims = np.full(8, 8.0)  # probe: 0.8 s/batch -> cap 16.0/0.8 = 20
 
         def walls(epoch):
@@ -89,13 +92,16 @@ class TestEndToEnd:
                 base *= 100.0       # mid-run slowdown
             return base
 
-        res = train_global(cfg(epochs_global=3, epochs_local=1,
+        res = train_global(cfg(epochs_global=4, epochs_local=1,
                                time_limit=16.0),
                            mesh=mesh8, simulated_durations=sims,
                            simulated_round_durations=walls, progress=False)
         caps = res["step_caps"]
-        assert len(caps) == 3
-        assert caps[2][0] < caps[1][0], caps
+        assert len(caps) == 4
+        # rounds 1-2 still see only the uniform round-0 wall
+        assert caps[2][0] == caps[1][0], caps
+        # round 3 consumed round 1's 100x wall through the delayed EMA
+        assert caps[3][0] < caps[2][0], caps
 
     def test_bert_mlm_end_to_end(self, mesh8):
         # BASELINE ladder entry 5 (BERT MLM): token task with [B, L] labels

@@ -77,6 +77,7 @@ def _run(devices, mesh_axes, model="mlp", dataset="mnist", **kw):
     return train_global(cfg, mesh=mesh, progress=False)
 
 
+@pytest.mark.slow
 class TestDriverFSDP:
     def test_matches_plain_dp_mlp(self, devices):
         plain = _run(devices[:2], {"data": 2})

@@ -61,6 +61,7 @@ class TestCausalAttention:
             atol=1e-5)
 
 
+@pytest.mark.slow
 class TestGPT:
     def test_gpt2_small_param_count_canonical(self):
         """Tied-head GPT-2 small == 124,439,808 params (the published

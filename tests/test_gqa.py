@@ -74,6 +74,7 @@ class TestDenseGrouped:
             dot_product_attention(q, k, v)
 
 
+@pytest.mark.slow
 class TestFlashGrouped:
     """The Pallas kernels (interpret mode on CPU) with grouped K/V block
     specs and the group-folded dK/dV grid."""
@@ -107,6 +108,7 @@ def _sharded(seq_mesh, fn):
         in_specs=(P(None, "seq"),) * 3, out_specs=P(None, "seq")))
 
 
+@pytest.mark.slow
 class TestRingGrouped:
     @pytest.mark.parametrize("causal", [False, True])
     def test_forward_matches_dense(self, seq_mesh, causal):
@@ -128,6 +130,7 @@ class TestRingGrouped:
             np.testing.assert_allclose(a, b, atol=1e-4)
 
 
+@pytest.mark.slow
 class TestUlyssesGrouped:
     def test_forward_matches_dense(self, seq_mesh):
         # seq axis 2 divides both H=4 and KV=2

@@ -90,6 +90,7 @@ def _run(devices, mesh_axes, **extra):
     return train_global(cfg, mesh=mesh, progress=False)
 
 
+@pytest.mark.slow
 class TestDriverLlama:
     def test_dp_loss_decreases(self, devices):
         res = _run(devices[:2], {"data": 2})
@@ -126,6 +127,7 @@ class TestDriverLlama:
                                    dense["global_train_losses"], rtol=2e-3)
 
 
+@pytest.mark.slow
 class TestGQA:
     """Grouped-query attention: separate q / kv projections, kv heads
     shared across query groups, broadcast after RoPE."""

@@ -159,6 +159,7 @@ class TestBert:
             (12, 768, 12, 3072)
 
 
+@pytest.mark.slow
 class TestFlashAttention:
     """Pallas flash kernel in interpret mode (CPU) vs the dense reference."""
 

@@ -92,6 +92,7 @@ class TestMoEFFN:
                                        err_msg=jax.tree_util.keystr(path))
 
 
+@pytest.mark.slow
 class TestMoETensorParallel:
     """MoE x TP (VERDICT r3 'next' #4): per-expert Megatron sharding of
     the F dim over a 'model' mesh axis, routing replicated — the sharded
@@ -175,6 +176,7 @@ class TestMoETensorParallel:
         assert any("model" in s and "expert" in s for s in specs)
 
 
+@pytest.mark.slow
 class TestDriverExpertParallel:
     """MoE-BERT training expert-sharded over (data=2, expert=2) must match
     the unsharded MoE data=2 run."""
@@ -210,6 +212,7 @@ class TestDriverExpertParallel:
             train_global(cfg, mesh=mesh, progress=False)
 
 
+@pytest.mark.slow
 class TestMoEScanAndPipeline:
     """MoE x scan_layers (the sown aux lifts through ``nn.scan`` stacked)
     and MoE x pipeline parallelism (bubble-masked aux through the GPipe
@@ -301,6 +304,7 @@ class TestMoEScanAndPipeline:
         assert any("pipe" in s and "expert" in s for s in specs)
 
 
+@pytest.mark.slow
 class TestDriverMoESequenceParallel:
     """MoE x SP (r5, guard lifted): each seq-parallel device routes its
     own chunk of every sequence — a declared semantics shift vs the
@@ -348,6 +352,7 @@ def _assert_params_close(res, ref, rtol=2e-3, atol=2e-4):
                                    rtol=rtol, atol=atol)
 
 
+@pytest.mark.slow
 class TestDriverMoEOneF1B:
     """1F1B x MoE (r5, the final 1F1B exclusion lifted): the stage
     applies with mutable aux so the sown load-balance losses are

@@ -89,6 +89,7 @@ class TestScannedBert:
             loop.apply({"params": pl_}, x, train=False), atol=1e-5)
 
 
+@pytest.mark.slow
 class TestDriverPipelineParallel:
     """BERT training pipelined over a (data=2, pipe=2) mesh must match the
     dense data=2 run: same shards, same rng, numerics within fp32
@@ -156,6 +157,7 @@ class TestPipelineRemat:
         np.testing.assert_allclose(outs[True], outs[False], atol=1e-6)
         assert sizes[True] < 0.6 * sizes[False], sizes
 
+    @pytest.mark.slow
     def test_driver_pp_remat_matches_dense(self, devices):
         run = TestDriverPipelineParallel()
         dense = run._run(devices[:2], {"data": 2})
@@ -164,6 +166,7 @@ class TestPipelineRemat:
                                    dense["global_train_losses"], rtol=2e-3)
 
 
+@pytest.mark.slow
 class TestOneF1B:
     """1F1B schedule (VERDICT r3 'next' #3): loss and every gradient tree
     must equal the dense reference exactly; residual memory must be
@@ -435,6 +438,7 @@ class TestOneF1B:
         assert f16 < gp16, (f16, gp16)
 
 
+@pytest.mark.slow
 class TestDriverPipelineTensorParallel:
     """3-D composition: (data=2, pipe=2, model=2) — the stacked layer axis
     shards over 'pipe' AND the inner Megatron dims over 'model'

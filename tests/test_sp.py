@@ -46,6 +46,7 @@ class TestRingAttention:
         ref = dot_product_attention(q, k, v)
         np.testing.assert_allclose(out, ref, atol=1e-5)
 
+    @pytest.mark.slow
     def test_grads_match_dense(self, seq_mesh):
         q, k, v = _qkv(seed=1)
         ring = _sharded(seq_mesh, lambda q, k, v: ring_attention(q, k, v, "seq"))
@@ -56,6 +57,7 @@ class TestRingAttention:
             np.testing.assert_allclose(a, b, atol=1e-4)
 
 
+@pytest.mark.slow
 class TestZigzagRing:
     """Zig-zag causal ring: device i holds half-chunks (i, 2n-1-i) so
     every rotation has exactly 2 live sub-blocks per device and the dead
@@ -78,6 +80,7 @@ class TestZigzagRing:
         ref = dot_product_attention(q, k, v, causal=True)
         np.testing.assert_allclose(out, ref, atol=1e-5)
 
+    @pytest.mark.slow
     def test_grads_match_dense(self, devices):
         mesh = Mesh(np.array(devices[:4]), ("seq",))
         q, k, v = _qkv(l=64, seed=5)
@@ -122,6 +125,7 @@ class TestUlyssesAttention:
         ref = dot_product_attention(q, k, v)
         np.testing.assert_allclose(out, ref, atol=1e-5)
 
+    @pytest.mark.slow
     def test_grads_match_dense(self, seq_mesh):
         q, k, v = _qkv(seed=3)
         uly = _sharded(seq_mesh, lambda q, k, v: ulysses_attention(q, k, v, "seq"))
@@ -132,6 +136,7 @@ class TestUlyssesAttention:
             np.testing.assert_allclose(a, b, atol=1e-4)
 
 
+@pytest.mark.slow
 class TestDriverSequenceParallel:
     """BERT training seq-sharded over a (data=2, seq=4) mesh must match the
     dense data=2 run: same shards, same rng, numerics within fp32 tolerance."""
@@ -176,6 +181,7 @@ def _composition_run(devices, mesh_axes, model="bert_tiny",
                         progress=False)
 
 
+@pytest.mark.slow
 class TestSeqTensorComposition:
     """SP x TP: ring attention over 'seq' with Megatron head/FFN shards
     over 'model' in the same step (heads are local to each model shard;
@@ -199,6 +205,7 @@ class TestSeqTensorComposition:
                                    dense["global_train_losses"], rtol=2e-3)
 
 
+@pytest.mark.slow
 class TestSeqFsdpComposition:
     """SP x FSDP: L over 'seq', B over 'fsdp' in the same step — the loss
     denominator and metric sums psum over BOTH partial-batch axes, grads
@@ -216,6 +223,7 @@ class TestSeqFsdpComposition:
         assert any("fsdp" in s for s in specs)
 
 
+@pytest.mark.slow
 class TestSeqPipelineComposition:
     """SP x PP: ring attention over 'seq' INSIDE each GPipe stage while
     activations rotate over 'pipe' between stages.  Runs with the

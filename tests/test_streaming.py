@@ -76,6 +76,7 @@ class TestStreamedRound:
             mesh=mesh8, progress=False)
         assert np.isfinite(res["global_train_losses"]).all()
 
+    @pytest.mark.slow
     def test_streamed_with_tensor_parallel(self, devices):
         """The streamed round must compose with TP param specs (the inner
         carry uses the sharded state specs) and match the packed TP round."""
@@ -92,6 +93,7 @@ class TestStreamedRound:
         np.testing.assert_allclose(streamed["global_train_losses"],
                                    packed["global_train_losses"], rtol=1e-5)
 
+    @pytest.mark.slow
     def test_streamed_with_fsdp(self, devices):
         """The streamed round must compose with ZeRO-3 shards (the inner
         carry and chunk programs use the fsdp specs, params gathered

@@ -160,6 +160,7 @@ class TestVocabParallelStats:
                                    atol=1e-6)
 
 
+@pytest.mark.slow
 class TestDriverTensorParallel:
     """BERT training TP-sharded over a (data=2, model=2) mesh must match
     the dense data=2 run: same shards, same rng, numerics within fp32

@@ -94,6 +94,7 @@ def _run(devices, mesh_axes, **cfg_kw):
     return train_global(cfg, mesh=mesh, progress=False)
 
 
+@pytest.mark.slow
 class TestDriverViT:
     def test_plain_dp_loss_decreases(self, devices):
         res = _run(devices[:2], {"data": 2})

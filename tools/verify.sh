@@ -1,0 +1,37 @@
+#!/usr/bin/env bash
+# Repo verification: the tier-1 test command (ROADMAP.md, verbatim
+# semantics) plus a bench smoke run of the headline entry.
+#
+# Usage:  tools/verify.sh
+# Env:    BENCH_BUDGET_S  — bench smoke budget in seconds (default 240;
+#                           the --entry CLI arms the same backstop as the
+#                           sweep, so slow/CPU-only hosts exit 0 with a
+#                           budget_backstop status line instead of hanging)
+#         SKIP_BENCH=1    — run the tier-1 tests only
+set -u
+cd "$(dirname "$0")/.."
+
+echo "== tier-1 tests (ROADMAP.md) =="
+set -o pipefail
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+  --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
+  2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+if [ "$rc" -ne 0 ]; then
+  echo "tier-1 FAILED (rc=$rc)"
+  exit "$rc"
+fi
+
+if [ "${SKIP_BENCH:-0}" != "1" ]; then
+  echo "== bench smoke: r50 headline entry =="
+  BENCH_BUDGET_S="${BENCH_BUDGET_S:-240}" python bench.py --entry r50
+  brc=$?
+  if [ "$brc" -ne 0 ]; then
+    echo "bench smoke FAILED (rc=$brc)"
+    exit "$brc"
+  fi
+fi
+
+echo "verify OK"
