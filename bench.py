@@ -536,6 +536,83 @@ def measure_flash_one_l(L: int, B: int) -> dict:
     }
 
 
+def measure_sync() -> dict:
+    """Dense vs sharded vs bf16-compressed round-sync A/B (ISSUE 2).
+
+    Times the three stand-alone sync programs (``comms.make_host_sync``)
+    over a worker-stacked, unevenly-shaped ~4 MB parameter pytree on the
+    full device mesh, and reports per-worker bytes-on-the-wire from the
+    shared bucket-plan accounting: dense injects the full replicated
+    buffer per worker; sharded sends 2(N-1)/N of each padded bucket
+    (reduce-scatter + all-gather phases); compressed halves that again
+    (bf16 wire).  Also asserts the fp32 sharded result is BIT-IDENTICAL
+    to dense and reports the compressed path's max deviation.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu import comms
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu.mesh import build_mesh
+
+    n = len(jax.devices())
+    mesh = build_mesh({"data": n})
+    rng = np.random.default_rng(0)
+    # uneven shapes: exercises bucket packing + padding (sizes not
+    # divisible by n); ~1M fp32 elements total
+    shapes = {"emb": (1999, 128), "w1": (128, 1024), "b1": (1031,),
+              "w2": (1024, 128), "head": (257, 399), "scale": (7,)}
+    tree = {k: jnp.asarray(rng.normal(size=(n, *s)), jnp.float32)
+            for k, s in shapes.items()}
+    res0 = {k: jnp.zeros((n, *s), jnp.float32) for k, s in shapes.items()}
+    per_worker = {k: jax.ShapeDtypeStruct(s, jnp.float32)
+                  for k, s in shapes.items()}
+    elems = sum(int(np.prod(s)) for s in shapes.values())
+
+    def time_sync(fn, residual):
+        out = fn(tree, residual)   # compile + warm
+        jax.block_until_ready(out)
+        samples = []
+        for _ in range(7):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(tree, residual))
+            samples.append(time.perf_counter() - t0)
+        samples.sort()
+        return out, samples[len(samples) // 2]
+
+    dense_fn = comms.make_host_sync(mesh, mode="dense")
+    sharded_fn = comms.make_host_sync(mesh, mode="sharded")
+    comp_fn = comms.make_host_sync(mesh, mode="sharded",
+                                   wire_dtype=jnp.bfloat16)
+    (dense_out, _), dense_s = time_sync(dense_fn, None)
+    (sharded_out, _), sharded_s = time_sync(sharded_fn, None)
+    (comp_out, _), comp_s = time_sync(comp_fn, res0)
+
+    b_dense = comms.sync_wire_bytes(per_worker, n, mode="dense")
+    b_sharded = comms.sync_wire_bytes(per_worker, n, mode="sharded",
+                                      wire_dtype=jnp.float32)
+    b_comp = comms.sync_wire_bytes(per_worker, n, mode="sharded",
+                                   wire_dtype=jnp.bfloat16)
+    bitwise = all(
+        np.array_equal(np.asarray(dense_out[k]), np.asarray(sharded_out[k]))
+        for k in shapes)
+    max_err = max(
+        float(np.abs(np.asarray(comp_out[k], np.float32)
+                     - np.asarray(dense_out[k], np.float32)).max())
+        for k in shapes)
+    return {
+        "n_workers": n,
+        "param_mb": round(4 * elems / 1e6, 2),
+        "dense": {"ms": round(dense_s * 1e3, 3), "wire_mb": round(b_dense / 1e6, 3)},
+        "sharded": {"ms": round(sharded_s * 1e3, 3), "wire_mb": round(b_sharded / 1e6, 3)},
+        "compressed": {"ms": round(comp_s * 1e3, 3), "wire_mb": round(b_comp / 1e6, 3)},
+        "sharded_vs_dense_bytes": round(b_sharded / b_dense, 4) if b_dense else None,
+        "expected_bytes_ratio": round(2 * (n - 1) / n, 4),
+        "bitwise_sharded_eq_dense": bool(bitwise),
+        "compressed_max_abs_err": max_err,
+    }
+
+
 def measure_round_gap() -> dict:
     """Host time between device rounds: serial vs overlapped pipeline.
 
@@ -708,6 +785,7 @@ SHORT = {
     "llama_medium_gqa4_lm_l1024": "llama_gqa4",
     "flash_attention": "flash",
     "round_gap": "rgap",
+    "sync_collectives": "sync",
 }
 
 
@@ -732,6 +810,8 @@ def _run_entry(key: str, entry_budget: float | None = None) -> dict:
                 for L, B, _t in FLASH_POINTS}
     if key == "round_gap":
         return measure_round_gap()
+    if key == "sync_collectives":
+        return measure_sync()
     for k, name, shape, batch, steps, ncls, tok, _tmo, *extra in LADDER:
         if k == key:
             return measure_model(name, shape, batch, steps, ncls, tok,
@@ -805,6 +885,12 @@ def _emit_headline(details: dict, extra: dict) -> None:
                      "ovl": e.get("gap_overlap_ms"),
                      "x": e.get("reduction_x"),
                      "same": 1 if e.get("results_identical") else 0}
+        elif key == "sync_collectives":
+            d[sk] = {"dn": (e.get("dense") or {}).get("ms"),
+                     "sh": (e.get("sharded") or {}).get("ms"),
+                     "cp": (e.get("compressed") or {}).get("ms"),
+                     "ratio": e.get("sharded_vs_dense_bytes"),
+                     "same": 1 if e.get("bitwise_sharded_eq_dense") else 0}
         elif key == "flash_attention":
             def _flash_cell(r):
                 if "train_flash_speedup" not in r:
@@ -906,9 +992,10 @@ def main() -> None:
     if not fast:
         at = next(i for i, (k, _t) in enumerate(jobs)
                   if k.startswith("vit_"))
-        # round_gap (the overlapped-pipeline host-gap A/B) + per-L flash
-        # units run before the sacrificial ViT tail
-        jobs[at:at] = ([("round_gap", 150)]
+        # round_gap (the overlapped-pipeline host-gap A/B), the sync-
+        # collective A/B, + per-L flash units run before the sacrificial
+        # ViT tail
+        jobs[at:at] = ([("round_gap", 150), ("sync_collectives", 120)]
                        + [(f"flash:L{L}", t) for L, _b, t in FLASH_POINTS])
     for key, tmo in jobs:
         rem = _remaining()

@@ -85,6 +85,29 @@ else:
         return lax.psum(1, axis_name)
 
 
+if hasattr(lax, "psum_scatter"):
+    psum_scatter = lax.psum_scatter
+else:
+    def psum_scatter(x, axis_name, *, scatter_dimension=0,
+                     axis_index_groups=None, tiled=False):
+        """Runtimes predating ``lax.psum_scatter``: dense fallback as
+        psum + this rank's tile.  Moves all-reduce bytes instead of
+        reduce-scatter bytes (it IS the dense collective), but keeps the
+        sharded sync engine runnable — bit-identical results, no wire
+        saving.  Only the ``tiled=True`` form the sync engine uses is
+        supported."""
+        if axis_index_groups is not None or not tiled:
+            raise NotImplementedError(
+                "legacy psum_scatter shim supports tiled=True without "
+                "axis_index_groups only")
+        full = lax.psum(x, axis_name)
+        n = axis_size(axis_name)
+        size = x.shape[scatter_dimension] // n
+        return lax.dynamic_slice_in_dim(
+            full, lax.axis_index(axis_name) * size, size,
+            axis=scatter_dimension)
+
+
 # True when the runtime predates the jax.shard_map / vma-typing surface;
 # legacy-only workarounds (re-certified replication, the custom-vjp
 # optimization barrier) key off this
@@ -138,3 +161,5 @@ def install() -> None:
         lax.pcast = pcast
     if not hasattr(lax, "axis_size"):
         lax.axis_size = axis_size
+    if not hasattr(lax, "psum_scatter"):
+        lax.psum_scatter = psum_scatter

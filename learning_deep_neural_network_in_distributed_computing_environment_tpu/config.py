@@ -117,6 +117,24 @@ class Config:
     # CLI defaults this to .jax_cache so bench/multi-run invocations on
     # one host stop paying recompiles; library/test callers opt in.
     compile_cache_dir: str = ""
+    # --- round-sync engine (sharded reduce-scatter collectives) ------------
+    # sync_mode: how the once-per-round parameter/gradient aggregation runs
+    # for the allreduce topology.  "sharded" = flatten-and-bucket ->
+    # psum_scatter -> scale the 1/N shard -> all_gather (bit-identical to
+    # dense in fp32); "dense" = per-leaf pmean/psum; "auto" = sharded on
+    # TPU (and whenever compression is requested), dense otherwise.
+    # Ring/double-ring gossip topologies always run dense (they are
+    # neighbor exchanges, not reductions).
+    sync_mode: str = "auto"          # auto | dense | sharded
+    # Wire dtype of the sharded sync collectives.  bfloat16 halves the
+    # bytes on the wire; fp32 keeps the bit-identical-to-dense guarantee.
+    sync_dtype: str = "float32"      # float32 | bfloat16
+    # Compression error handling for sync_dtype=bfloat16: "ef" carries
+    # fp32 error-feedback residuals in the train state (weights mode), so
+    # quantization error accumulates in the residual, not the parameters.
+    sync_compression: str = "none"   # none | ef
+    # Sharded-sync bucket size (MiB of fp32 parameters per collective).
+    sync_bucket_mb: float = 4.0
 
     def __post_init__(self) -> None:
         _choices("backend", self.backend, ("jax", "gloo", "nccl", "mpi"))
@@ -126,6 +144,26 @@ class Config:
         _choices("data_mode", self.data_mode, ("balanced", "disbalanced"))
         _choices("proportionality", self.proportionality, ("inverse", "direct", "uniform"))
         _choices("attention_impl", self.attention_impl, ("dense", "flash"))
+        _choices("sync_mode", self.sync_mode, ("auto", "dense", "sharded"))
+        _choices("sync_dtype", self.sync_dtype, ("float32", "bfloat16"))
+        _choices("sync_compression", self.sync_compression, ("none", "ef"))
+        if self.sync_dtype == "bfloat16" and self.sync_mode == "dense":
+            raise ValueError(
+                "--sync_dtype bfloat16 is the sharded engine's compressed "
+                "wire format; it cannot combine with --sync_mode dense")
+        if self.sync_dtype == "bfloat16" and self.topology != "allreduce":
+            raise ValueError(
+                "--sync_dtype bfloat16 rides the sharded reduce-scatter "
+                "engine, which applies to --topology allreduce only; "
+                f"got {self.topology!r} (gossip exchanges stay dense) — "
+                "the flags would otherwise be silently ignored")
+        if self.sync_compression == "ef" and self.sync_dtype != "bfloat16":
+            raise ValueError(
+                "--sync_compression ef compensates bfloat16 wire rounding; "
+                "it requires --sync_dtype bfloat16")
+        if self.sync_bucket_mb <= 0:
+            raise ValueError(
+                f"sync_bucket_mb must be positive, got {self.sync_bucket_mb}")
         if not 0.0 <= self.local_weight <= 1.0:
             raise ValueError(f"local_weight must be in [0,1], got {self.local_weight}")
         if not 0.0 <= self.fixed_ratio <= 1.0:
@@ -263,6 +301,23 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="persistent XLA compilation cache directory "
                         "('' disables); repeated runs on one host skip "
                         "recompiles")
+    p.add_argument("--sync_mode", type=str, default=d.sync_mode,
+                   choices=["auto", "dense", "sharded"],
+                   help="round-sync engine for the allreduce topology: "
+                        "sharded = bucketed reduce-scatter/all-gather "
+                        "(bit-identical to dense in fp32), auto = sharded "
+                        "on TPU, dense otherwise")
+    p.add_argument("--sync_dtype", type=str, default=d.sync_dtype,
+                   choices=["float32", "bfloat16"],
+                   help="wire dtype of the sharded sync collectives "
+                        "(bfloat16 halves bytes on the wire)")
+    p.add_argument("--sync_compression", type=str,
+                   default=d.sync_compression, choices=["none", "ef"],
+                   help="ef = carry fp32 error-feedback residuals in train "
+                        "state so bf16 wire rounding does not accumulate "
+                        "into the parameters (weights aggregation)")
+    p.add_argument("--sync_bucket_mb", type=float, default=d.sync_bucket_mb,
+                   help="sharded-sync bucket size in MiB per collective")
     return p
 
 

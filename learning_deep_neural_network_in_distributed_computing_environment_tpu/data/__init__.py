@@ -8,6 +8,7 @@ from .partition import (  # noqa: F401
     contiguous_partition,
     efficiency_ratios,
     fixed_classes_for_rank,
+    PackBufferPool,
     pack_shard,
     pack_window,
     repartition,

@@ -194,7 +194,8 @@ def budget_from_time_limit(own_batches: int, probe_sec_per_batch: float,
 
 
 def pack_window(images: np.ndarray, labels: np.ndarray, indices: np.ndarray,
-                batch_size: int, start_step: int, num_steps: int
+                batch_size: int, start_step: int, num_steps: int,
+                out: tuple[np.ndarray, np.ndarray, np.ndarray] | None = None
                 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Materialize steps [start_step, start_step + num_steps) of one
     worker's epoch as fixed-shape arrays — the unit of the streamed input
@@ -205,6 +206,13 @@ def pack_window(images: np.ndarray, labels: np.ndarray, indices: np.ndarray,
     around the worker's own real samples so shapes stay static for jit
     without skewing BatchNorm batch statistics toward one sample; the mask
     zeroes loss/metric contributions.
+
+    ``out`` — optional (x, y, mask) destination buffers of exactly the
+    return shapes/dtypes: the gathers run as ``np.take(..., out=...)``
+    into them instead of allocating fresh stacks, the double-buffered
+    packed-path staging path (ROADMAP overlap follow-on (c)).  The buffers
+    must be C-contiguous (a leading-axis slice of a contiguous worker
+    stack is).
     """
     idx = np.asarray(indices)
     n = len(idx)
@@ -218,11 +226,55 @@ def pack_window(images: np.ndarray, labels: np.ndarray, indices: np.ndarray,
         take = np.where(pos < n, idx[np.minimum(pos, n - 1)],
                         idx[(pos - n) % n])
         mask = (pos < n).astype(np.float32)
+    if out is not None:
+        x_out, y_out, m_out = out
+        np.take(images, take, axis=0,
+                out=x_out.reshape(len(pos), *images.shape[1:]))
+        np.take(labels, take, axis=0,
+                out=y_out.reshape(len(pos), *labels.shape[1:]))
+        m_out.reshape(-1)[:] = mask
+        return x_out, y_out, m_out
     x = images[take].reshape(num_steps, batch_size, *images.shape[1:])
     # labels may be per-example scalars (classification) or per-token
     # sequences [L] (MLM) — keep any trailing label dims
     y = labels[take].reshape(num_steps, batch_size, *labels.shape[1:])
     return x, y, mask.reshape(num_steps, batch_size)
+
+
+class PackBufferPool:
+    """Recycled host staging buffers for the packed input path.
+
+    Every round used to allocate fresh [N, S, B, ...] numpy stacks for the
+    train and val packs; this pool hands out each distinct
+    (key, shape, dtype) buffer from a two-deep rotation instead — classic
+    double buffering.  Reuse is safe because a buffer handed out for round
+    r is next handed out for round r+2, by which time round r's
+    host->device transfer (and the round program itself, which the
+    dispatch chain orders first) has completed.  A shape change (the step
+    budget moved with the repartition) retires the rotation slot and
+    allocates fresh.
+    """
+
+    def __init__(self, depth: int = 2):
+        self._depth = max(1, int(depth))
+        self._slots: dict = {}   # key -> list of buffers, round-robin
+        self._next: dict = {}    # key -> next rotation index
+
+    def take(self, key, shape: tuple, dtype) -> np.ndarray:
+        shape = tuple(int(s) for s in shape)
+        dtype = np.dtype(dtype)
+        slot = self._slots.setdefault(key, [])
+        i = self._next.get(key, 0) % self._depth
+        self._next[key] = i + 1
+        if i < len(slot):
+            buf = slot[i]
+            if buf.shape == shape and buf.dtype == dtype:
+                return buf
+            slot[i] = np.empty(shape, dtype)
+            return slot[i]
+        buf = np.empty(shape, dtype)
+        slot.append(buf)
+        return buf
 
 
 def pack_shard(images: np.ndarray, labels: np.ndarray, indices: np.ndarray,

@@ -36,7 +36,8 @@ from .data import (
     efficiency_ratios,
     fixed_classes_for_rank,
     load_dataset,
-    pack_shard,
+    PackBufferPool,
+    pack_window,
     repartition,
     skew_partition,
     skew_repartition,
@@ -144,11 +145,16 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     ``datasets``: optional (train, val, test) ``Dataset`` triple override.
     """
     initialize_distributed()
+    from .xla_flags import compile_cache_counts, install_cache_counter
     if cfg.compile_cache_dir:
         # persistent XLA compilation cache: bench/test/multi-run
         # invocations on the same host stop paying round-program recompiles
         from .xla_flags import setup_compile_cache
         setup_compile_cache(cfg.compile_cache_dir)
+    # hit/miss telemetry even when the cache was armed earlier (CLI) or is
+    # off (counts then stay zero); the per-run delta lands in results
+    install_cache_counter()
+    cache_counts0 = compile_cache_counts()
     if mesh is None:
         axes = cfg.mesh_axes()
         if cfg.num_workers:
@@ -472,12 +478,27 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                 for i, p in enumerate(parts)]
         return idxs, sizes
 
-    def pack_all(ds, parts, caps=None):
+    # Double-buffered host staging for the packed path (ROADMAP overlap
+    # follow-on (c)): pack_all gathers straight into a two-deep rotation of
+    # reusable [N, S, B, ...] stacks via np.take(..., out=...) instead of
+    # allocating fresh ones every round.  A buffer handed out for round r
+    # returns at round r+2, after round r's host->device transfer is done.
+    pack_pool = PackBufferPool()
+
+    def pack_all(ds, parts, kind: str, caps=None):
         idxs, sizes = _capped(parts, caps)
         steps = _round_up(step_budget(sizes, batch), 4)
-        xs, ys, ms = zip(*(pack_shard(ds.images, ds.labels, p, batch, steps)
-                           for p in idxs))
-        return np.stack(xs), np.stack(ys), np.stack(ms)
+        xs = pack_pool.take((kind, "x"),
+                            (n, steps, batch, *ds.images.shape[1:]),
+                            ds.images.dtype)
+        ys = pack_pool.take((kind, "y"),
+                            (n, steps, batch, *ds.labels.shape[1:]),
+                            ds.labels.dtype)
+        ms = pack_pool.take((kind, "m"), (n, steps, batch), np.float32)
+        for i, p in enumerate(idxs):
+            pack_window(ds.images, ds.labels, p, batch, 0, steps,
+                        out=(xs[i], ys[i], ms[i]))
+        return xs, ys, ms
 
     def chunk_feed(ds, parts, caps=None):
         """Streamed alternative to pack_all: a per-epoch iterator of
@@ -529,6 +550,17 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     # keeps the serial data flow (identical results either way).
     overlap = cfg.overlap_rounds and jax.process_count() == 1
     streaming = cfg.stream_chunk_steps > 0
+    # ROADMAP overlap follow-on (a): the pre-dispatch state barrier exists
+    # for the 1-core XLA:CPU collective rendezvous (a second in-flight
+    # round program can starve it past its deadline -> SIGABRT).  On real
+    # accelerators collectives execute in stream order, so the overlapped
+    # pipeline may keep TWO rounds in flight: round r+1 is dispatched
+    # before round r completes, and the host blocks only on round r-1's
+    # completion marker (never the state itself — its buffers are donated
+    # into the next round the moment it is dispatched).  Checkpoint rounds
+    # and the final round still barrier (the save reads the state).
+    deep_pipeline = (overlap and not streaming
+                     and jax.default_backend() != "cpu")
 
     def build_inputs(tparts, vparts, caps):
         if streaming:
@@ -537,8 +569,8 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         # pack AND stage onto device at prep time: in the overlapped
         # pipeline this runs while the previous round computes, so the
         # host->device transfer rides under device time too
-        return engine.stage_pack(pack_all(trainset, tparts, caps),
-                                 pack_all(valset, vparts))
+        return engine.stage_pack(pack_all(trainset, tparts, "train", caps),
+                                 pack_all(valset, vparts, "val"))
 
     def make_prep(tparts, vparts):
         """Caps + packed/staged inputs for the round about to run, from
@@ -659,6 +691,44 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     prep = (make_prep(train_parts, val_parts)
             if start_epoch < cfg.epochs_global else None)
     t_ready = None
+    # deep pipeline only: the round whose completion barrier was deferred
+    inflight: list = []              # [(epoch, marker, t_disp, timing, steps)]
+    # completion time of the previously settled round: with two rounds in
+    # flight, a round's device time runs from max(its dispatch, the
+    # previous round's completion) — measuring from dispatch alone would
+    # double-count (the marker only completes after the previous round's
+    # remaining compute), inflating the EMA and halving step caps
+    t_done_prev: list = [None]
+
+    def record_walls(ep: int, wall: float, steps_run, timing_: dict):
+        timing_["compute_ms"] = round(wall * 1e3, 3)
+        # record the measured wall for DELAYED consumption: the EMA
+        # blends it in when round ep + 2 is being prepared
+        if simulated_round_durations is not None:
+            worker_walls = np.asarray(
+                simulated_round_durations(ep), np.float64)
+        else:
+            # total steps this round = epochs_local x (train + val
+            # steps); attribute the wall to train steps proportionally
+            worker_walls = _measured_worker_walls(wall, n) / max(
+                cfg.epochs_local, 1)
+        walls_by_round[ep] = (worker_walls, steps_run)
+
+    def finish_inflight():
+        """Deep pipeline: block on the deferred round's completion marker
+        and record its wall.  Runs BEFORE the next prepare_next, so the
+        delayed-EMA repartition consumes exactly the same wall set as the
+        serial flow (walls through round r-1 when preparing round r+1)."""
+        if not inflight:
+            return
+        ep, marker, t_disp_, timing_, steps_ = inflight.pop()
+        jax.block_until_ready(marker)
+        t_done = time.perf_counter()
+        start = t_disp_ if t_done_prev[0] is None \
+            else max(t_disp_, t_done_prev[0])
+        t_done_prev[0] = t_done
+        record_walls(ep, t_done - start, steps_, timing_)
+
     try:
         for global_epoch in epoch_iter:
             # fail fast on metric-worker errors: a fetch/assembly failure
@@ -684,40 +754,58 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                 state, handle = engine.round_start(state, *prep["inputs"])
             timing["stage_ms"] = round(
                 (time.perf_counter() - t_disp) * 1e3, 3)
+            if engine.last_sync_stats:
+                # static per-round sync telemetry (bytes on the wire,
+                # mode); the measured collective wall joins after
+                # round_wait when a standalone sync program ran
+                timing.update(engine.last_sync_stats)
             cur_steps_run = prep["steps_run"]
             if overlap:
                 pending.append(executor.submit(
                     metrics_job, handle, global_epoch, t_disp, timing))
-                if global_epoch + 1 < cfg.epochs_global:
-                    t0 = time.perf_counter()
-                    prep = prepare_next(global_epoch, cur_steps_run)
-                    timing["prep_ms"] = round(
-                        (time.perf_counter() - t0) * 1e3, 3)
-            state = engine.round_wait(state)
-            t_ready = time.perf_counter()
-            wall = t_ready - t_disp
-            timing["compute_ms"] = round(wall * 1e3, 3)
-            # record the measured wall for DELAYED consumption: the EMA
-            # blends it in when round global_epoch + 2 is being prepared
-            if simulated_round_durations is not None:
-                worker_walls = np.asarray(
-                    simulated_round_durations(global_epoch), np.float64)
-            else:
-                # total steps this round = epochs_local x (train + val
-                # steps); attribute the wall to train steps proportionally
-                worker_walls = _measured_worker_walls(wall, n) / max(
-                    cfg.epochs_local, 1)
-            walls_by_round[global_epoch] = (worker_walls, cur_steps_run)
+            ckpt_due = bool(cfg.checkpoint_dir and cfg.checkpoint_every
+                            and (global_epoch + 1) % cfg.checkpoint_every
+                            == 0)
+            last_round = global_epoch + 1 >= cfg.epochs_global
+            defer = deep_pipeline and not ckpt_due and not last_round
+            # settle the PREVIOUS deferred round first in either case: its
+            # wall must be on record before prepare_next runs, so the
+            # delayed-EMA repartition consumes the same wall set as the
+            # serial flow
+            finish_inflight()
+            if defer:
+                # two rounds in flight: leave THIS round computing
+                inflight.append((global_epoch,
+                                 engine.round_done_marker(handle),
+                                 t_disp, timing, cur_steps_run))
+                t_ready = None  # device not idle between rounds here
+            if overlap and not last_round:
+                t0 = time.perf_counter()
+                prep = prepare_next(global_epoch, cur_steps_run)
+                timing["prep_ms"] = round(
+                    (time.perf_counter() - t0) * 1e3, 3)
+            if not defer:
+                state = engine.round_wait(state)
+                if engine.last_sync_stats:
+                    timing.update(engine.last_sync_stats)
+                t_ready = time.perf_counter()
+                # the barrier round right after a deferred one also started
+                # computing only when its predecessor finished (same
+                # double-count hazard finish_inflight corrects)
+                start = t_disp if t_done_prev[0] is None \
+                    else max(t_disp, t_done_prev[0])
+                t_done_prev[0] = t_ready
+                record_walls(global_epoch, t_ready - start,
+                             cur_steps_run, timing)
             if not overlap:
                 metrics_job(handle, global_epoch, t_disp, timing)
-                if global_epoch + 1 < cfg.epochs_global:
+                if not last_round:
                     t0 = time.perf_counter()
                     prep = prepare_next(global_epoch, cur_steps_run)
                     timing["prep_ms"] = round(
                         (time.perf_counter() - t0) * 1e3, 3)
 
-            if (cfg.checkpoint_dir and cfg.checkpoint_every
-                    and (global_epoch + 1) % cfg.checkpoint_every == 0):
+            if ckpt_due:
                 # every process enters (the save gathers collectively);
                 # only process 0 writes the file.  The state is ready and
                 # the next round is NOT yet dispatched, so the save reads
@@ -734,6 +822,19 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         pbar.close()
     if profiling:
         jax.profiler.stop_trace()
+
+    # persistent-compile-cache effectiveness for THIS run (ROADMAP open
+    # item): how many executable lookups the armed cache served vs compiled
+    cache_counts = compile_cache_counts()
+    results["compile_cache"] = {
+        "enabled": bool(cfg.compile_cache_dir),
+        "hits": cache_counts["hits"] - cache_counts0["hits"],
+        "misses": cache_counts["misses"] - cache_counts0["misses"],
+    }
+    log.info("compile cache: %s, %d hits / %d misses this run",
+             "on" if results["compile_cache"]["enabled"] else "off",
+             results["compile_cache"]["hits"],
+             results["compile_cache"]["misses"])
 
     results["state"] = state
     results["mesh"] = mesh

@@ -44,6 +44,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from typing import Any, Callable
 
 import jax
@@ -73,6 +74,11 @@ class TrainState(struct.PyTreeNode):
     opt_state: PyTree        # Adam moments — local to each worker, never synced
     lr_epoch: jnp.ndarray    # int32, local epochs completed (StepLR clock)
     rng: jnp.ndarray         # uint32[2] raw PRNG key per worker
+    # fp32 error-feedback residuals for the bf16-compressed sharded sync
+    # (None = compression off).  Params-shaped, carried across rounds like
+    # the Adam moments: each round re-injects what bf16 wire rounding
+    # dropped from this worker's previous contribution (comms.sharded_sync).
+    sync_residual: PyTree = None
 
 
 def _first_worker_row(x):
@@ -358,6 +364,117 @@ class LocalSGDEngine:
         self.tx = optax.scale_by_adam(b1=0.9, b2=0.999, eps=1e-8)
         self._round_cache: dict[tuple, Callable] = {}
         self._spec = P(DATA_AXIS)
+        # --- round-sync engine selection (ISSUE 2) ---------------------
+        self.sync_mode = self._resolve_sync_mode()
+        self.sync_wire_dtype = (jnp.bfloat16
+                                if cfg.sync_dtype == "bfloat16"
+                                else jnp.float32)
+        # error feedback needs per-worker residual state, which only the
+        # weights (FedAvg) aggregation carries forward; in gradients mode
+        # the aggregate is discarded after its norm, so compression error
+        # has nothing to accumulate into
+        self.sync_ef = (cfg.sync_compression == "ef"
+                        and cfg.aggregation_by == "weights"
+                        and self.sync_mode == "sharded")
+        self.sync_bucket_bytes = max(1, int(cfg.sync_bucket_mb * (1 << 20)))
+        # Packed-path sync placement: on XLA:CPU the sync stays FUSED in
+        # the round program — dispatching a second collective program
+        # while the round is in flight risks the 1-core rendezvous
+        # starvation the driver's barrier exists for.  Elsewhere the sync
+        # runs as its own donated program dispatched right behind the
+        # round, which gives a measurable per-round collective wall and
+        # the two-rounds-in-flight dispatch chain (driver deep pipeline).
+        self.split_sync = jax.default_backend() != "cpu"
+        self.last_sync_stats: dict | None = None
+        self._sync_probe = None      # (ready_marker | None, sync_out_ref)
+        self._sync_bytes: int | None = None
+
+    # ------------------------------------------------------------------
+    # Round-sync engine (ISSUE 2): dense vs sharded reduce-scatter
+    # ------------------------------------------------------------------
+    def _resolve_sync_mode(self) -> str:
+        """Pick the round-sync implementation from config + backend.
+
+        ``sharded`` applies to the allreduce topology only (gossip rings
+        are neighbor exchanges, not reductions).  ``auto`` chooses sharded
+        on TPU — where reduce-scatter/all-gather ride the ICI ring at
+        2(N-1)/N of the replicated buffer per worker — and whenever bf16
+        compression is requested (compression is a sharded-engine
+        feature); the XLA:CPU test backend and legacy-JAX meshes with
+        inner (TP/PP/EP) axes keep the dense twin, which is bit-identical
+        in fp32 anyway."""
+        cfg = self.cfg
+        if cfg.sync_mode == "sharded":
+            if cfg.topology != "allreduce":
+                raise ValueError(
+                    f"--sync_mode sharded applies to --topology allreduce "
+                    f"(a reduce-scatter needs a reduction); got "
+                    f"{cfg.topology!r}")
+            return "sharded"
+        if cfg.sync_mode == "dense":
+            return "dense"
+        if cfg.topology != "allreduce":
+            return "dense"
+        if cfg.sync_dtype == "bfloat16":
+            return "sharded"
+        if LEGACY_SHARD_MAP and self._inner_axes:
+            # legacy check_rep's psum_scatter replication tracking is not
+            # exercised under inner axes; the dense path is proven there
+            return "dense"
+        return "sharded" if jax.default_backend() == "tpu" else "dense"
+
+    def _sync_body(self, params, grads, residual):
+        """The once-per-round sync point, per worker (inside shard_map).
+
+        Returns ``(params', residual', agg_grad_norm)``.  Weights mode
+        replaces params with the aggregate (FedAvg); gradients mode runs
+        the collectives on the stale last-batch grads and reports only
+        their norm (reference semantics, SURVEY.md 3.2)."""
+        cfg = self.cfg
+        agg_grad_norm = jnp.zeros(())
+        if cfg.aggregation_by == "weights":
+            if self.sync_mode == "sharded":
+                params, residual = comms.sharded_sync(
+                    params, how=cfg.aggregation_type,
+                    local_weight=cfg.local_weight,
+                    wire_dtype=self.sync_wire_dtype,
+                    residual=residual if self.sync_ef else None,
+                    bucket_bytes=self.sync_bucket_bytes)
+            else:
+                params = comms.aggregate(
+                    params, how=cfg.aggregation_type,
+                    topology=cfg.topology, local_weight=cfg.local_weight)
+        else:
+            if self.sync_mode == "sharded":
+                agg, _ = comms.sharded_sync(
+                    grads, how=cfg.aggregation_type,
+                    local_weight=cfg.local_weight,
+                    wire_dtype=self.sync_wire_dtype,
+                    bucket_bytes=self.sync_bucket_bytes)
+            else:
+                agg = comms.aggregate(
+                    grads, how=cfg.aggregation_type,
+                    topology=cfg.topology, local_weight=cfg.local_weight)
+            agg_grad_norm = self._grad_global_norm(agg)
+        return params, residual, agg_grad_norm
+
+    def _arm_sync_stats(self, params_stacked) -> None:
+        """Reset ``last_sync_stats`` for the round being dispatched: the
+        static per-round wire bytes (from the bucket plan over per-worker
+        logical shapes) + mode; ``round_wait`` adds the measured
+        ``sync_ms`` when a standalone sync program ran."""
+        if self._sync_bytes is None:
+            shapes = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype),
+                params_stacked)
+            wire = (self.sync_wire_dtype if self.sync_mode == "sharded"
+                    else jnp.float32)
+            self._sync_bytes = comms.sync_wire_bytes(
+                shapes, self.n_workers, mode=self.sync_mode,
+                wire_dtype=wire, bucket_bytes=self.sync_bucket_bytes)
+        self.last_sync_stats = {"sync_bytes": self._sync_bytes,
+                                "sync_mode": self.sync_mode}
+        self._sync_probe = None
 
     # ------------------------------------------------------------------
     # Multi-host data movement
@@ -426,6 +543,9 @@ class LocalSGDEngine:
             rng=jax.vmap(lambda i: jax.random.key_data(
                 jax.random.fold_in(jax.random.key(self.cfg.seed), i)))(
                     jnp.arange(n)),
+            sync_residual=(jax.tree_util.tree_map(
+                lambda x: jnp.zeros((n, *x.shape), jnp.float32), params)
+                if self.sync_ef else None),
         )
         if self.param_specs_fn is not None:
             self.param_specs = self.param_specs_fn(params)
@@ -468,7 +588,8 @@ class LocalSGDEngine:
         return TrainState(
             params=pfull, batch_stats=dspec(state.batch_stats),
             opt_state=opt_specs(state.opt_state),
-            lr_epoch=self._spec, rng=self._spec)
+            lr_epoch=self._spec, rng=self._spec,
+            sync_residual=pfull if self.sync_ef else None)
 
     # ------------------------------------------------------------------
     # The round program
@@ -850,20 +971,16 @@ class LocalSGDEngine:
                                      length=epochs_local)
 
             # --- the sync point (trainer.py:141-150) -----------------------
+            # On CPU the sync engine (dense pmean or the sharded
+            # reduce-scatter, _sync_body) runs fused HERE; under
+            # split_sync the round program stops pre-sync and round_start
+            # dispatches the standalone donated sync program right behind
+            # it (measured collective wall, two-rounds-in-flight chain).
             agg_grad_norm = jnp.zeros(())
-            if cfg.aggregation_by == "weights":
-                params = comms.aggregate(
-                    params, how=cfg.aggregation_type, topology=cfg.topology,
-                    local_weight=cfg.local_weight)
-            else:
-                # gradients mode: reference averages stale last-batch grads
-                # which the next zero_grad() discards — collectives run,
-                # weights unchanged (SURVEY.md 3.2).  Report the norm so the
-                # behavior is observable.
-                agg = comms.aggregate(
-                    last_grads, how=cfg.aggregation_type,
-                    topology=cfg.topology, local_weight=cfg.local_weight)
-                agg_grad_norm = self._grad_global_norm(agg)
+            residual = state.sync_residual
+            if not self.split_sync:
+                params, residual, agg_grad_norm = self._sync_body(
+                    params, last_grads, residual)
 
             # cross-worker global-epoch metric means (trainer.py:152-162)
             metrics = dict(
@@ -880,23 +997,34 @@ class LocalSGDEngine:
             )
             new_state = TrainState(params=params, batch_stats=batch_stats,
                                    opt_state=opt_state, lr_epoch=lr_epoch,
-                                   rng=rng)
+                                   rng=rng, sync_residual=residual)
+            if emit_grads:
+                # split_sync x gradients mode: the standalone sync program
+                # aggregates the stale last-batch grads, so the round
+                # program must surface them
+                return new_state, last_grads, metrics
             return new_state, metrics
 
         def stacked(state, x, y, m, xv, yv, mv):
             squeeze = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
             expand = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
-            new_state, metrics = per_worker(
+            outs = per_worker(
                 squeeze(state), *map(lambda a: a[0], (x, y, m, xv, yv, mv)))
-            new_state = self._certify_replication(new_state, sspec)
-            metrics = self._certify_replication(metrics, self._spec)
-            return expand(new_state), expand(metrics)
+            new_state = self._certify_replication(outs[0], sspec)
+            metrics = self._certify_replication(outs[-1], self._spec)
+            mid = tuple(self._certify_replication(o, pspec)
+                        for o in outs[1:-1])
+            return tuple(map(expand, (new_state, *mid, metrics)))
 
         sspec = self._sspec if self._sspec is not None else self._spec
+        pspec = self._sspec.params if self._sspec is not None else self._spec
+        emit_grads = self.split_sync and cfg.aggregation_by == "gradients"
         in_specs = (sspec,) + self._pack_specs(shapes_key) * 2
+        out_specs = ((sspec, pspec, self._spec) if emit_grads
+                     else (sspec, self._spec))
         fn = shard_map(
             stacked, mesh=self.mesh,
-            in_specs=in_specs, out_specs=(sspec, self._spec),
+            in_specs=in_specs, out_specs=out_specs,
             **self._sm_kwargs())
         return jax.jit(fn, donate_argnums=(0,))
 
@@ -962,15 +1090,62 @@ class LocalSGDEngine:
         if key not in self._round_cache:
             log.info("compiling round program for shapes %s", key)
             self._round_cache[key] = self._build_round(key)
-        new_state, metrics = self._round_cache[key](
-            state, x, y, m, xv, yv, mv)
-        return new_state, ("packed", metrics)
+        outs = self._round_cache[key](state, x, y, m, xv, yv, mv)
+        new_state, metrics = outs[0], outs[-1]
+        self._arm_sync_stats(new_state.params)
+        sync_norm = fence = None
+        if self.split_sync:
+            # the sync program consumes the round's outputs, so its
+            # dispatch chains behind the still-running round program; the
+            # probe lets round_wait time the collective wall separately
+            if "sync" not in self._round_cache:
+                self._round_cache["sync"] = self._build_sync()
+            sync = self._round_cache["sync"]
+            if self.cfg.aggregation_by == "weights":
+                if self.sync_ef:
+                    params, residual, fence = sync(new_state.params,
+                                                   new_state.sync_residual)
+                else:
+                    params, fence = sync(new_state.params)
+                    residual = new_state.sync_residual
+                new_state = new_state.replace(params=params,
+                                              sync_residual=residual)
+            else:
+                sync_norm = sync(outs[1])
+                fence = sync_norm
+            self._sync_probe = (metrics["train_loss"], fence)
+        return new_state, ("packed", metrics, sync_norm, fence)
 
-    @staticmethod
-    def round_wait(new_state: TrainState) -> TrainState:
+    def round_wait(self, new_state: TrainState) -> TrainState:
         """Block until a dispatched round's state is materialized — the
-        barrier that keeps at most one round program in flight."""
+        barrier that keeps at most one round program in flight.
+
+        When a standalone sync program ran (split_sync / streamed rounds),
+        also measures its collective wall into ``last_sync_stats``: block
+        on the round-program marker first, then time the block on the sync
+        output — the difference is the sync program's execution (plus its
+        dispatch overhead)."""
+        probe, self._sync_probe = self._sync_probe, None
+        if probe is not None:
+            marker, out_ref = probe
+            if marker is not None:
+                jax.block_until_ready(marker)
+            t0 = time.perf_counter()
+            jax.block_until_ready(out_ref)
+            if self.last_sync_stats is not None:
+                self.last_sync_stats["sync_ms"] = round(
+                    (time.perf_counter() - t0) * 1e3, 3)
         return jax.block_until_ready(new_state)
+
+    def round_done_marker(self, handle):
+        """A small, never-donated device array that materializes when the
+        round's device work — including any standalone sync program — has
+        completed.  The deep-pipeline driver blocks on this instead of the
+        state (whose buffers the NEXT round's dispatch already donated)."""
+        if handle[0] != "packed":
+            raise ValueError("round_done_marker applies to packed rounds")
+        _, metrics, _sync_norm, fence = handle
+        return fence if fence is not None else metrics["train_loss"]
 
     def finish_metrics(self, handle) -> dict:
         """Fetch + assemble a dispatched round's host metrics.
@@ -979,7 +1154,13 @@ class LocalSGDEngine:
         from a worker thread while the NEXT round is already running —
         the overlapped driver pipeline does exactly that."""
         if handle[0] == "packed":
-            return self._fetch(handle[1])
+            _, metrics, sync_norm, _fence = handle
+            mx = self._fetch(metrics)
+            if sync_norm is not None:
+                # split_sync x gradients mode: the norm came from the
+                # standalone sync program, not the round program
+                mx["agg_grad_norm"] = self._fetch(sync_norm)
+            return mx
         _, per_epoch, agg_grad_norm = handle
         return self._assemble_streamed(per_epoch, agg_grad_norm)
 
@@ -1057,28 +1238,47 @@ class LocalSGDEngine:
             out_specs=self._spec)
 
     def _build_sync(self):
+        """The standalone donated sync program (streamed rounds on every
+        backend; packed rounds under split_sync).  One compiled shard_map
+        program runs the whole sync engine — bucketed reduce-scatter /
+        scale-on-shard / all-gather, or the dense twin — with the inputs
+        donated so the once-per-round parameter sync updates in place.
+
+        The extra ``fence`` output (weights mode) is a tiny per-worker
+        scalar derived from the synced params: a never-donated completion
+        marker for the sync-wall probe and the deep-pipeline driver."""
         cfg = self.cfg
 
-        def per_worker(params, grads):
-            agg_grad_norm = jnp.zeros(())
-            if cfg.aggregation_by == "weights":
-                params = comms.aggregate(
-                    params, how=cfg.aggregation_type, topology=cfg.topology,
-                    local_weight=cfg.local_weight)
-            else:
-                agg = comms.aggregate(
-                    grads, how=cfg.aggregation_type, topology=cfg.topology,
-                    local_weight=cfg.local_weight)
-                agg_grad_norm = self._grad_global_norm(agg)
-            return params, agg_grad_norm
+        def _fence(params):
+            f = jnp.sum(jax.tree_util.tree_leaves(params)[0]).astype(
+                jnp.float32)
+            # a TP/PP/EP-sharded leaf sums to a shard-varying value; make
+            # the fence invariant along inner axes so the P(data) out-spec
+            # holds (its VALUE is irrelevant — only its completion is)
+            return lax.psum(f, self._inner_axes) if self._inner_axes else f
 
         pspec = self._sspec.params if self._sspec is not None else self._spec
-        # params and last-grads are both last-use at the sync point: donate
-        # them so the once-per-round parameter sync updates in place
-        # instead of copying every replica
-        return self._wrap_stacked(per_worker, [pspec, pspec],
-                                  out_specs=(pspec, self._spec),
-                                  donate=(0, 1))
+        if cfg.aggregation_by == "weights":
+            if self.sync_ef:
+                def per_worker(params, residual):
+                    p, r, _ = self._sync_body(params, None, residual)
+                    return p, r, _fence(p)
+                return self._wrap_stacked(
+                    per_worker, [pspec, pspec],
+                    out_specs=(pspec, pspec, self._spec), donate=(0, 1))
+
+            def per_worker(params):
+                p, _, _ = self._sync_body(params, None, None)
+                return p, _fence(p)
+            return self._wrap_stacked(per_worker, [pspec],
+                                      out_specs=(pspec, self._spec),
+                                      donate=(0,))
+
+        def per_worker(grads):
+            _, _, norm = self._sync_body(None, grads, None)
+            return norm
+        return self._wrap_stacked(per_worker, [pspec],
+                                  out_specs=self._spec, donate=(0,))
 
     def _staged_chunks(self, gen):
         """Iterator of device-staged (x, y, m) chunk triples.
@@ -1163,11 +1363,31 @@ class LocalSGDEngine:
         params, batch_stats, opt_state, rng, last_grads = inner
         if "sync" not in self._round_cache:
             self._round_cache["sync"] = self._build_sync()
-        params, agg_grad_norm = self._round_cache["sync"](params, last_grads)
+        sync = self._round_cache["sync"]
+        self._arm_sync_stats(params)
+        residual = state.sync_residual
+        if cfg.aggregation_by == "weights":
+            if self.sync_ef:
+                params, residual, fence = sync(params, residual)
+            else:
+                params, fence = sync(params)
+            # weights mode reports a zero norm; keep it a sharded device
+            # array so the multi-host metric fetch (process_allgather)
+            # sees the same global [N] layout as the gradients mode
+            agg_grad_norm = self._put(
+                np.zeros((self.n_workers,), np.float32), self._spec)
+        else:
+            agg_grad_norm = sync(last_grads)
+            fence = agg_grad_norm
+        # everything before the sync is already materialized (the
+        # per-epoch barrier above), so the block on the fence times the
+        # sync program's collectives alone
+        self._sync_probe = (None, fence)
 
         new_state = TrainState(
             params=params, batch_stats=batch_stats, opt_state=opt_state,
-            lr_epoch=state.lr_epoch + cfg.epochs_local, rng=rng)
+            lr_epoch=state.lr_epoch + cfg.epochs_local, rng=rng,
+            sync_residual=residual)
         return new_state, ("streamed", per_epoch, agg_grad_norm)
 
     def _assemble_streamed(self, per_epoch, agg_grad_norm) -> dict:

@@ -33,7 +33,8 @@ def ensure_sequential_cpu_collectives() -> bool:
     return True
 
 
-def setup_compile_cache(cache_dir: str) -> bool:
+def setup_compile_cache(cache_dir: str,
+                        min_compile_secs: float = 1.0) -> bool:
     """Enable JAX's persistent compilation cache at ``cache_dir``.
 
     Compiled executables (the round programs, bench entries) are keyed by
@@ -43,18 +44,60 @@ def setup_compile_cache(cache_dir: str) -> bool:
     runtime lacks the config knobs or the backend doesn't support
     persistent caching (the cache is an optimization, never a
     correctness dependency).  Imports jax lazily so this module stays
-    importable before backend init.
+    importable before backend init.  Also arms the hit/miss counter so
+    runs can report cache effectiveness (``compile_cache_counts``).
     """
     if not cache_dir:
         return False
     import jax
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          min_compile_secs)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        install_cache_counter()
         return True
     except Exception:  # noqa: BLE001 — optimization only
         return False
+
+
+# --- persistent-cache hit/miss telemetry (ROADMAP open item) ---------------
+# JAX's compilation cache emits monitoring events on every lookup; the
+# listener below turns them into process-level counters a run can snapshot
+# before/after (driver.train_global reports the delta per run).
+
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_MISS_EVENT = "/jax/compilation_cache/cache_misses"
+_cache_counts = {"hits": 0, "misses": 0}
+_cache_counter_installed = False
+
+
+def install_cache_counter() -> bool:
+    """Register a jax monitoring listener counting persistent-cache hits
+    and misses.  Idempotent; returns False when the runtime lacks the
+    monitoring surface (counts then stay zero — telemetry only)."""
+    global _cache_counter_installed
+    if _cache_counter_installed:
+        return True
+    try:
+        from jax._src import monitoring
+
+        def _listen(event, **kwargs):
+            if event == _CACHE_HIT_EVENT:
+                _cache_counts["hits"] += 1
+            elif event == _CACHE_MISS_EVENT:
+                _cache_counts["misses"] += 1
+
+        monitoring.register_event_listener(_listen)
+        _cache_counter_installed = True
+        return True
+    except Exception:  # noqa: BLE001 — telemetry only
+        return False
+
+
+def compile_cache_counts() -> dict:
+    """Cumulative persistent-cache {hits, misses} for this process."""
+    return dict(_cache_counts)
 
 
 def sequential_cpu_collectives_pinned() -> bool:

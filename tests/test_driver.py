@@ -103,18 +103,24 @@ class TestEndToEnd:
         # round 3 consumed round 1's 100x wall through the delayed EMA
         assert caps[3][0] < caps[2][0], caps
 
+    @pytest.mark.slow
     def test_bert_mlm_end_to_end(self, mesh8):
         # BASELINE ladder entry 5 (BERT MLM): token task with [B, L] labels
-        # through pack_shard -> engine -> eval (VERDICT r1 missing #2)
+        # through pack_shard -> engine -> eval (VERDICT r1 missing #2).
+        # slow tier (ISSUE 2 triage): the two bert driver e2e cases are the
+        # longest tier-1 rounds (~50 s combined); bert coverage stays in
+        # tier-1 via test_models_extra/test_pp unit+module tests
         res = run(mesh8, model="bert_tiny", dataset="synthetic_mlm",
                   epochs_global=2, epochs_local=1, batch_size=8,
                   limit_train_samples=256, limit_eval_samples=64, lr=1e-3)
         assert res["global_train_losses"][-1] < res["global_train_losses"][0]
         assert np.isfinite(res["global_train_losses"]).all()
 
+    @pytest.mark.slow
     def test_bert_mlm_final_evaluation(self, mesh8):
         # the rank-0 evaluator must handle [B, L] token labels (masked
-        # positions only) without crashing and produce finite P/R/F1
+        # positions only) without crashing and produce finite P/R/F1.
+        # slow tier (ISSUE 2 triage), see test_bert_mlm_end_to_end
         from learning_deep_neural_network_in_distributed_computing_environment_tpu.eval import evaluate
         from learning_deep_neural_network_in_distributed_computing_environment_tpu.train import rank0_variables
         res = run(mesh8, model="bert_tiny", dataset="synthetic_mlm",
@@ -127,3 +133,30 @@ class TestEndToEnd:
         assert np.isfinite(loss) and 0.0 <= acc <= 100.0
         assert preds.shape == labels.shape
         assert all(np.isfinite(v) for v in metrics.values())
+
+
+class TestCompileCacheTelemetry:
+    def test_counter_counts_monitoring_events(self):
+        # the persistent-cache hit/miss report rides jax's monitoring
+        # events; count them directly so the plumbing is verified without
+        # depending on backend cache support
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.xla_flags import (
+            compile_cache_counts,
+            install_cache_counter,
+        )
+        assert install_cache_counter()
+        from jax._src import monitoring
+        before = compile_cache_counts()
+        monitoring.record_event("/jax/compilation_cache/cache_hits")
+        monitoring.record_event("/jax/compilation_cache/cache_misses")
+        monitoring.record_event("/jax/compilation_cache/cache_misses")
+        after = compile_cache_counts()
+        assert after["hits"] - before["hits"] == 1
+        assert after["misses"] - before["misses"] == 2
+
+    def test_train_global_reports_per_run_delta(self, mesh8):
+        # enabled=False run: counters exist and the delta is zero
+        res = train_global(cfg(epochs_global=1), mesh=mesh8, progress=False)
+        assert res["compile_cache"]["enabled"] is False
+        assert res["compile_cache"]["hits"] >= 0
+        assert res["compile_cache"]["misses"] >= 0

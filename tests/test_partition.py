@@ -8,7 +8,9 @@ from learning_deep_neural_network_in_distributed_computing_environment_tpu.data 
     contiguous_partition,
     efficiency_ratios,
     fixed_classes_for_rank,
+    PackBufferPool,
     pack_shard,
+    pack_window,
     repartition,
     skew_partition,
     skew_repartition,
@@ -127,6 +129,54 @@ class TestStepBudget:
         assert x.shape == (3, 4, 1, 1, 1)
         assert m.sum() == 10  # 10 real examples, 2 masked pads
         assert m[2, 2] == 0 and m[2, 1] == 1
+
+
+class TestPackBuffers:
+    """Double-buffered host staging (ISSUE 2 satellite: np.take(out=))."""
+
+    def test_pack_window_out_matches_fresh_alloc(self):
+        rng = np.random.default_rng(0)
+        imgs = rng.normal(size=(30, 2, 2, 3)).astype(np.float32)
+        labels = rng.integers(0, 5, (30, 7)).astype(np.int64)  # token task
+        idx = rng.permutation(30)[:10]
+        ref = pack_window(imgs, labels, idx, batch_size=4, start_step=0,
+                          num_steps=3)
+        bufs = (np.empty((3, 4, 2, 2, 3), np.float32),
+                np.empty((3, 4, 7), np.int64),
+                np.empty((3, 4), np.float32))
+        out = pack_window(imgs, labels, idx, batch_size=4, start_step=0,
+                          num_steps=3, out=bufs)
+        for o, b, r in zip(out, bufs, ref):
+            assert o is b  # filled in place, no fresh allocation
+            np.testing.assert_array_equal(o, r)
+
+    def test_pack_window_out_into_stacked_worker_slice(self):
+        # the driver packs each worker into a leading-axis slice of one
+        # contiguous [N, S, B, ...] stack — the reshape inside must view
+        imgs = np.arange(40, dtype=np.float32).reshape(40, 1)
+        labels = np.arange(40)
+        stack = np.zeros((2, 3, 4, 1), np.float32)
+        ystack = np.zeros((2, 3, 4), np.int64)
+        mstack = np.zeros((2, 3, 4), np.float32)
+        for i, idx in enumerate((np.arange(10), np.arange(10, 22))):
+            pack_window(imgs, labels, idx, 4, 0, 3,
+                        out=(stack[i], ystack[i], mstack[i]))
+        ref0 = pack_window(imgs, labels, np.arange(10), 4, 0, 3)
+        np.testing.assert_array_equal(stack[0], ref0[0])
+        np.testing.assert_array_equal(mstack[1], np.ones((3, 4)))
+
+    def test_pool_rotates_two_buffers_per_key(self):
+        pool = PackBufferPool()
+        a = pool.take("x", (4, 2), np.float32)
+        b = pool.take("x", (4, 2), np.float32)
+        assert a is not b
+        assert pool.take("x", (4, 2), np.float32) is a  # round r+2 reuses r
+        assert pool.take("x", (4, 2), np.float32) is b
+        # a shape change (step budget moved) retires the slot
+        c = pool.take("x", (6, 2), np.float32)
+        assert c.shape == (6, 2) and c is not a and c is not b
+        # distinct keys never share buffers
+        assert pool.take("y", (4, 2), np.float32) is not a
 
 
 class TestSources:

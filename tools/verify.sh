@@ -14,11 +14,15 @@ cd "$(dirname "$0")/.."
 echo "== tier-1 tests (ROADMAP.md) =="
 set -o pipefail
 rm -f /tmp/_t1.log
+t1_start=$SECONDS
 timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
   2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
 echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+# wall-time visibility: the tier-1 budget is 870 s — regressions toward it
+# should be seen long before timeout -k kills the run
+echo "TIER1_WALL_S=$((SECONDS - t1_start)) (budget 870)"
 if [ "$rc" -ne 0 ]; then
   echo "tier-1 FAILED (rc=$rc)"
   exit "$rc"
