@@ -613,6 +613,166 @@ def measure_sync() -> dict:
     }
 
 
+def measure_compile() -> dict:
+    """Layer-scan compile-engine A/B (ISSUE 3): trace+compile wall and
+    step wall for scanned vs unrolled GPT at several depths, plus the
+    remat-policy and grad-accumulation variants of the scanned stack.
+
+    The scanned stack traces its block ONCE under ``lax.scan`` regardless
+    of depth, so its trace+compile wall is ~flat in L while the unrolled
+    twin's grows linearly — the acceptance bar is >= 2x lower wall at
+    L=8.  Bit-identity: the scanned forward on TRANSPLANTED unrolled
+    params (``layer{i}`` leaves stacked along the layer axis) must
+    produce the bit-identical loss at grad_accum=1.  The persistent
+    compile cache is disabled for this entry (a warm cache would time
+    cache lookups, not compiles) and restored after."""
+    import functools as ft
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import get_model
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu import train as train_lib
+
+    VOCAB, B, L_SEQ = 211, 8, 32
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, VOCAB, (B, L_SEQ)), jnp.int32)
+    y = jnp.asarray(rng.integers(0, VOCAB, (B, L_SEQ)), jnp.int32)
+    tx = optax.adam(1e-3)
+
+    def build(depth, scan, remat_policy=None):
+        return get_model("gpt_tiny", num_classes=VOCAB, num_layers=depth,
+                         max_len=L_SEQ, scan_layers=scan,
+                         remat_policy=remat_policy)
+
+    def make_step(model, grad_accum=1):
+        def loss_fn(p, xk, yk):
+            out = model.apply({"params": p}, xk, train=True)
+            return train_lib.softmax_cross_entropy(out, yk).mean()
+
+        @ft.partial(jax.jit, donate_argnums=0)
+        def step(state):
+            params, opt_state = state
+            if grad_accum > 1:
+                xs = x.reshape(grad_accum, B // grad_accum, L_SEQ)
+                ys = y.reshape(grad_accum, B // grad_accum, L_SEQ)
+
+                def micro(acc, inp):
+                    xk, yk = inp
+                    l_k, g_k = jax.value_and_grad(loss_fn)(params, xk, yk)
+                    g, l = acc
+                    g = jax.tree_util.tree_map(
+                        lambda a, d: a + d.astype(jnp.float32) / grad_accum,
+                        g, g_k)
+                    return (g, l + l_k / grad_accum), None
+
+                zeros = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                (grads, loss), _ = jax.lax.scan(
+                    micro, (zeros, jnp.zeros(())), (xs, ys))
+            else:
+                loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), new_opt
+        return step
+
+    def time_config(model, grad_accum=1):
+        params = jax.jit(lambda k: model.init(k, x, train=False))(
+            jax.random.key(0))["params"]
+        state = (params, jax.jit(tx.init)(params))
+        step = make_step(model, grad_accum)
+        t0 = time.perf_counter()
+        compiled = step.lower(state).compile()
+        compile_s = time.perf_counter() - t0
+        state = compiled(state)  # warm
+        jax.block_until_ready(state)
+        walls = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            state = compiled(state)
+            jax.block_until_ready(state)
+            walls.append(time.perf_counter() - t0)
+        walls.sort()
+        return compile_s, walls[len(walls) // 2]
+
+    # a warm persistent cache would time cache LOOKUPS, not compiles —
+    # and jax LATCHES the cache object at the first compile, so clearing
+    # the config dir alone is a no-op once any earlier entry compiled;
+    # un-latch as well (and again on restore, so later entries re-arm)
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu.xla_flags import (
+        reset_cache_latch,
+    )
+    cache_dir = None
+    try:
+        cache_dir = jax.config.jax_compilation_cache_dir
+        jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:  # noqa: BLE001 — knob absent on some runtimes
+        pass
+    reset_cache_latch()
+    try:
+        configs = []
+        for depth in (2, 4, 8):
+            for scan in (False, True):
+                c, s = time_config(build(depth, scan))
+                configs.append({
+                    "L": depth, "layer_scan": "on" if scan else "off",
+                    "remat_policy": "none", "grad_accum": 1,
+                    "compile_s": round(c, 3), "step_ms": round(s * 1e3, 3)})
+        for policy in ("dots_saveable", "everything"):
+            c, s = time_config(build(8, True, policy))
+            configs.append({
+                "L": 8, "layer_scan": "on", "remat_policy": policy,
+                "grad_accum": 1, "compile_s": round(c, 3),
+                "step_ms": round(s * 1e3, 3)})
+        c, s = time_config(build(8, True), grad_accum=4)
+        configs.append({
+            "L": 8, "layer_scan": "on", "remat_policy": "none",
+            "grad_accum": 4, "compile_s": round(c, 3),
+            "step_ms": round(s * 1e3, 3)})
+    finally:
+        if cache_dir:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            reset_cache_latch()
+
+    # bit-identity at grad_accum=1: stack the unrolled init's layer{i}
+    # subtrees along a leading layer axis -> the scanned layout; the
+    # losses must match BITWISE (same math, same order — lax.scan just
+    # indexes the stacked operands)
+    mu, ms = build(4, False), build(4, True)
+    pu = jax.jit(lambda k: mu.init(k, x, train=False))(
+        jax.random.key(1))["params"]
+    stacked = jax.tree_util.tree_map(
+        lambda *ls: jnp.stack(ls), *[pu[f"layer{i}"] for i in range(4)])
+    pt = {k: v for k, v in pu.items() if not k.startswith("layer")}
+    pt["layers"] = {"layer": stacked}
+
+    def loss_of(m, p):
+        out = m.apply({"params": p}, x, train=True)
+        return train_lib.softmax_cross_entropy(out, y).mean()
+
+    lu = jax.jit(lambda p: loss_of(mu, p))(pu)
+    ls_ = jax.jit(lambda p: loss_of(ms, p))(pt)
+    bitwise = bool(np.asarray(lu) == np.asarray(ls_))
+
+    def pick(L, scan):
+        return next(c for c in configs
+                    if c["L"] == L and c["layer_scan"] == scan
+                    and c["remat_policy"] == "none"
+                    and c["grad_accum"] == 1)
+
+    unr8, scn8 = pick(8, "off"), pick(8, "on")
+    return {
+        "configs": configs,
+        "compile_speedup_L8": round(
+            unr8["compile_s"] / max(scn8["compile_s"], 1e-9), 2),
+        "compile_unrolled_L8_s": unr8["compile_s"],
+        "compile_scanned_L8_s": scn8["compile_s"],
+        "loss_bitwise_scan_vs_unrolled": bitwise,
+    }
+
+
 def measure_round_gap() -> dict:
     """Host time between device rounds: serial vs overlapped pipeline.
 
@@ -786,6 +946,7 @@ SHORT = {
     "flash_attention": "flash",
     "round_gap": "rgap",
     "sync_collectives": "sync",
+    "compile_engine": "compile",
 }
 
 
@@ -812,6 +973,8 @@ def _run_entry(key: str, entry_budget: float | None = None) -> dict:
         return measure_round_gap()
     if key == "sync_collectives":
         return measure_sync()
+    if key == "compile_engine":
+        return measure_compile()
     for k, name, shape, batch, steps, ncls, tok, _tmo, *extra in LADDER:
         if k == key:
             return measure_model(name, shape, batch, steps, ncls, tok,
@@ -891,6 +1054,12 @@ def _emit_headline(details: dict, extra: dict) -> None:
                      "cp": (e.get("compressed") or {}).get("ms"),
                      "ratio": e.get("sharded_vs_dense_bytes"),
                      "same": 1 if e.get("bitwise_sharded_eq_dense") else 0}
+        elif key == "compile_engine":
+            d[sk] = {"x": e.get("compile_speedup_L8"),
+                     "unr": e.get("compile_unrolled_L8_s"),
+                     "scn": e.get("compile_scanned_L8_s"),
+                     "same": 1 if e.get("loss_bitwise_scan_vs_unrolled")
+                     else 0}
         elif key == "flash_attention":
             def _flash_cell(r):
                 if "train_flash_speedup" not in r:
@@ -995,7 +1164,8 @@ def main() -> None:
         # round_gap (the overlapped-pipeline host-gap A/B), the sync-
         # collective A/B, + per-L flash units run before the sacrificial
         # ViT tail
-        jobs[at:at] = ([("round_gap", 150), ("sync_collectives", 120)]
+        jobs[at:at] = ([("round_gap", 150), ("sync_collectives", 120),
+                        ("compile_engine", 150)]
                        + [(f"flash:L{L}", t) for L, _b, t in FLASH_POINTS])
     for key, tmo in jobs:
         rem = _remaining()

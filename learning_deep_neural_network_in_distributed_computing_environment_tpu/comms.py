@@ -183,7 +183,9 @@ def sync_wire_bytes(tree: PyTree, n: int, *, mode: str = "sharded",
       worker injects S x 4 bytes (the dense path is always fp32);
     - ``sharded``: reduce-scatter sends (N-1)/N of each padded bucket and
       all-gather sends its (N-1)/N again, in the wire dtype —
-      2(N-1)/N x padded x itemsize per bucket.
+      2(N-1)/N x padded x itemsize per bucket (int8's per-bucket fp32
+      scale adds 8 bytes per worker per bucket — noise next to the
+      payload; excluded from the accounting).
     """
     leaves = jax.tree_util.tree_leaves(tree)
     if not leaves or n <= 1:
@@ -209,8 +211,14 @@ def sharded_sync(tree: PyTree, *, how: str = "equal",
     ``equal`` is the cross-worker mean, ``weighted`` the self-exclusive
     peer-mean blend — in fp32 both are bit-identical to the dense path.
 
-    ``wire_dtype`` compresses the two collective phases (bf16 halves the
-    wire bytes); ``residual`` enables error feedback for the compression:
+    ``wire_dtype`` compresses the two collective phases: bfloat16 halves
+    the wire bytes (plain downcast); int8 quarters them via symmetric
+    per-bucket quantization — each worker scales its bucket by
+    ``max|x| / 127`` (an fp32 scalar riding a tiny all-gather next to the
+    int8 payload), rounds to the nearest int8 step, and receivers
+    dequantize with the sender's scale before the fp32 accumulation, so
+    the sum is exact in fp32 given the quantized contributions.
+    ``residual`` enables error feedback for the compression:
     each worker carries (a) the fp32 rounding error of its own compressed
     contribution and (b) n x the rounding error of the gathered mean over
     the shard it owns, both re-injected through next round's sum — so
@@ -249,30 +257,63 @@ def sharded_sync(tree: PyTree, *, how: str = "equal",
             parts.append(jnp.zeros((b.padded - filled,), jnp.float32))
         buf = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
         wdt = jnp.dtype(wire_dtype) if wire_dtype is not None else b.dtype
-        sent = buf.astype(wdt)
+        quantized = wdt == jnp.dtype(jnp.int8)
+
+        def encode(x32):
+            """fp32 vector -> (wire payload, fp32 decode of the payload,
+            per-bucket fp32 scale or None).  bf16 is a plain downcast;
+            int8 is symmetric round-to-nearest on a max|x|/127 grid."""
+            if not quantized:
+                y = x32.astype(wdt)
+                return y, y.astype(jnp.float32), None
+            scale = jnp.maximum(jnp.max(jnp.abs(x32)) / 127.0,
+                                jnp.float32(1e-30))
+            q = jnp.clip(jnp.round(x32 / scale), -127.0, 127.0).astype(
+                jnp.int8)
+            return q, q.astype(jnp.float32) * scale, scale
+
+        def gather_decoded(payload, scale):
+            """all_gather the wire payload (+ its per-worker scale for
+            int8) and decode each worker's segment with ITS scale."""
+            full = lax.all_gather(payload, axis_name, tiled=True).astype(
+                jnp.float32)
+            if not quantized:
+                return full
+            scales = lax.all_gather(scale, axis_name)           # [n]
+            return (full.reshape(n, -1) * scales[:, None]).reshape(-1)
+
+        sent, sent32, sent_scale = encode(buf)
         if new_res is not None:
-            # error feedback: what bf16 rounding dropped from THIS worker's
-            # contribution rides into next round's pre-compression sum
-            err = buf - sent.astype(jnp.float32)
-        compressed = jnp.dtype(wdt) != jnp.dtype(jnp.float32)
+            # error feedback: what wire rounding dropped from THIS
+            # worker's contribution rides into next round's
+            # pre-compression sum
+            err = buf - sent32
+        compressed = wdt != jnp.dtype(jnp.float32)
         if compressed:
             # compressed reduce-scatter as all-to-all of wire-dtype shard
             # slices + LOCAL fp32 accumulation.  psum_scatter on bf16
             # would accumulate IN bf16, where one worker's grid-crossing
             # update can vanish into the sum's coarser grid (at sum ~ n|p|
             # the quantum is ~n x larger) — an error no residual can see,
-            # because the fp32 truth never exists anywhere.  Wire traffic
-            # is identical to reduce-scatter: each worker sends (n-1)/n of
+            # because the fp32 truth never exists anywhere.  (int8 cannot
+            # ride psum_scatter at all: integer accumulation would wrap
+            # and each worker has its own scale.)  Wire traffic is
+            # identical to reduce-scatter: each worker sends (n-1)/n of
             # the bucket.
             pieces = lax.all_to_all(sent.reshape(n, b.padded // n),
                                     axis_name, 0, 0)
-            shard32 = jnp.sum(pieces.astype(jnp.float32), axis=0)
+            if quantized:
+                scales = lax.all_gather(sent_scale, axis_name)   # [n]
+                shard32 = jnp.sum(pieces.astype(jnp.float32)
+                                  * scales[:, None], axis=0)
+            else:
+                shard32 = jnp.sum(pieces.astype(jnp.float32), axis=0)
         else:
             shard32 = psum_scatter(sent, axis_name, scatter_dimension=0,
                                    tiled=True).astype(jnp.float32)
         if how == "equal":
             mean32 = shard32 / n
-            mean = mean32.astype(wdt)
+            mean, mean32_dec, mean_scale = encode(mean32)
             if new_res is not None and compressed:
                 # second-stage error feedback: the gathered mean is ALSO
                 # wire-quantized, and that rounding recurs every round on
@@ -281,20 +322,19 @@ def sharded_sync(tree: PyTree, *, how: str = "equal",
                 # error into its own residual at the shard's positions —
                 # next round's mean divides the n back out, delivering
                 # the correction one round delayed.
-                e2 = mean32 - mean.astype(jnp.float32)
+                e2 = mean32 - mean32_dec
                 err = err + lax.dynamic_update_slice(
                     jnp.zeros((b.padded,), jnp.float32), n * e2,
                     (lax.axis_index(axis_name) * (b.padded // n),))
-            full = lax.all_gather(mean, axis_name, tiled=True).astype(
-                jnp.float32)
+            full = gather_decoded(mean, mean_scale)
         else:
             # weighted needs the per-worker OWN value elementwise, so the
             # gather redistributes the raw sum and the blend runs locally;
             # own is the compressed own contribution — the value the peers
             # actually received
-            total = lax.all_gather(shard32.astype(wdt), axis_name,
-                                   tiled=True).astype(jnp.float32)
-            own = sent.astype(jnp.float32)
+            tq, _tq32, tq_scale = encode(shard32)
+            total = gather_decoded(tq, tq_scale)
+            own = sent32
             full = w * own + (1.0 - w) * (total - own) / (n - 1)
         for (i, off, size) in b.items:
             leaf = leaves[i]

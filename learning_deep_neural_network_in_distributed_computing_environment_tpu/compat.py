@@ -133,6 +133,31 @@ else:
     optimization_barrier = lax.optimization_barrier
 
 
+# Named rematerialization policies for the layer-scan engine (ISSUE 3).
+# "everything" REMATERIALIZES everything (saves nothing — jax's
+# ``nothing_saveable``, the historical ``remat=True`` behavior);
+# "dots_saveable" saves matmul/einsum outputs and recomputes only the
+# cheap elementwise chains between them — the pjit/TPUv4 scaling report's
+# default selective-remat recipe.  Returning None means "no policy kwarg"
+# (jax.checkpoint's default, which is full remat), so a runtime lacking a
+# named policy degrades to remat-everything instead of crashing.
+REMAT_POLICIES = ("none", "dots_saveable", "everything")
+
+
+def checkpoint_policy(name):
+    """Resolve a named ``--remat_policy`` to a ``jax.checkpoint`` policy
+    callable (or None = jax's default full remat).  ``name`` must be one
+    of ``REMAT_POLICIES`` minus "none" — callers gate the "none" (no
+    remat at all) case themselves."""
+    if name not in REMAT_POLICIES or name == "none":
+        raise ValueError(
+            f"remat policy must be one of {REMAT_POLICIES[1:]}, got {name!r}")
+    policies = getattr(jax, "checkpoint_policies", None)
+    if name == "dots_saveable":
+        return getattr(policies, "dots_saveable", None)
+    return getattr(policies, "nothing_saveable", None)
+
+
 _SDS_HAS_VMA = "vma" in inspect.signature(
     jax.ShapeDtypeStruct.__init__).parameters
 

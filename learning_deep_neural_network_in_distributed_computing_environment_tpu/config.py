@@ -91,9 +91,36 @@ class Config:
     #                                  interleaves one backward per
     #                                  forward, capping in-flight
     #                                  residuals at O(stages) not O(M)
-    pp_remat: bool = False           # rematerialize each layer under PP
-    #                                  (GPipe-paper memory recipe: save
-    #                                  only layer-boundary activations)
+    pp_remat: bool = False           # [compat alias] rematerialize each
+    #                                  layer under PP — equivalent to
+    #                                  --remat_policy everything (kept so
+    #                                  existing launch scripts work)
+    # --- layer-scan compile engine (ISSUE 3) -------------------------------
+    # layer_scan: stack each homogeneous transformer block's parameters
+    # along a leading layer axis and run the stack under lax.scan — the
+    # block traces/compiles ONCE instead of num_layers times, so compile
+    # wall and HLO size stop growing with depth.  "auto" = on for the
+    # homogeneous-block families (bert_*/gpt_*/llama_*/vit_*), off for
+    # CNN/MLP models (heterogeneous blocks cannot stack); "on" requires a
+    # homogeneous-block model; "off" keeps the unrolled twin (pipeline
+    # parallelism still forces the stacked structure — the 'pipe' axis
+    # shards the layer dim).
+    layer_scan: str = "auto"         # auto | on | off
+    # remat_policy: named jax.checkpoint policy for the scanned layer
+    # stack (replaces the old remat bool).  "none" saves every
+    # intermediate (fastest, most HBM); "dots_saveable" saves matmul
+    # outputs and recomputes elementwise chains; "everything"
+    # rematerializes the whole block from its boundary activations (the
+    # GPipe-paper recipe, max memory saving at ~1/3 extra forward
+    # compute).  Applies to the scanned stack (layer_scan on / PP).
+    remat_policy: str = "none"       # none | dots_saveable | everything
+    # grad_accum: split each train step's batch into K microbatches and
+    # scan them with a donated fp32 gradient carry — per-device activation
+    # memory is bounded by B/K while the effective batch, the optimizer
+    # step count, and the round-sync cadence are unchanged.  Matches the
+    # full-batch step within fp32 summation tolerance (exact at K=1:
+    # the K=1 path is the unmodified step).
+    grad_accum: int = 1
     num_experts: int = 0             # >0 => MoE FFN in bert/gpt layers
     num_kv_heads: int = 0            # >0 => GQA (llama_* models)
     expert_capacity_factor: float = 1.25
@@ -127,9 +154,11 @@ class Config:
     # neighbor exchanges, not reductions).
     sync_mode: str = "auto"          # auto | dense | sharded
     # Wire dtype of the sharded sync collectives.  bfloat16 halves the
-    # bytes on the wire; fp32 keeps the bit-identical-to-dense guarantee.
-    sync_dtype: str = "float32"      # float32 | bfloat16
-    # Compression error handling for sync_dtype=bfloat16: "ef" carries
+    # bytes on the wire; int8 quarters them (per-bucket fp32 scale,
+    # symmetric round-to-nearest — the second compression tier); fp32
+    # keeps the bit-identical-to-dense guarantee.
+    sync_dtype: str = "float32"      # float32 | bfloat16 | int8
+    # Compression error handling for compressed sync_dtype: "ef" carries
     # fp32 error-feedback residuals in the train state (weights mode), so
     # quantization error accumulates in the residual, not the parameters.
     sync_compression: str = "none"   # none | ef
@@ -144,23 +173,37 @@ class Config:
         _choices("data_mode", self.data_mode, ("balanced", "disbalanced"))
         _choices("proportionality", self.proportionality, ("inverse", "direct", "uniform"))
         _choices("attention_impl", self.attention_impl, ("dense", "flash"))
+        _choices("layer_scan", self.layer_scan, ("auto", "on", "off"))
+        _choices("remat_policy", self.remat_policy,
+                 ("none", "dots_saveable", "everything"))
         _choices("sync_mode", self.sync_mode, ("auto", "dense", "sharded"))
-        _choices("sync_dtype", self.sync_dtype, ("float32", "bfloat16"))
+        _choices("sync_dtype", self.sync_dtype,
+                 ("float32", "bfloat16", "int8"))
         _choices("sync_compression", self.sync_compression, ("none", "ef"))
-        if self.sync_dtype == "bfloat16" and self.sync_mode == "dense":
+        if self.grad_accum < 1:
             raise ValueError(
-                "--sync_dtype bfloat16 is the sharded engine's compressed "
-                "wire format; it cannot combine with --sync_mode dense")
-        if self.sync_dtype == "bfloat16" and self.topology != "allreduce":
+                f"grad_accum must be >= 1, got {self.grad_accum}")
+        if self.grad_accum > 1 and self.batch_size % self.grad_accum:
             raise ValueError(
-                "--sync_dtype bfloat16 rides the sharded reduce-scatter "
-                "engine, which applies to --topology allreduce only; "
-                f"got {self.topology!r} (gossip exchanges stay dense) — "
-                "the flags would otherwise be silently ignored")
-        if self.sync_compression == "ef" and self.sync_dtype != "bfloat16":
+                f"--batch_size {self.batch_size} must be divisible by "
+                f"--grad_accum {self.grad_accum} (microbatch split)")
+        compressed_wire = self.sync_dtype in ("bfloat16", "int8")
+        if compressed_wire and self.sync_mode == "dense":
             raise ValueError(
-                "--sync_compression ef compensates bfloat16 wire rounding; "
-                "it requires --sync_dtype bfloat16")
+                f"--sync_dtype {self.sync_dtype} is the sharded engine's "
+                "compressed wire format; it cannot combine with "
+                "--sync_mode dense")
+        if compressed_wire and self.topology != "allreduce":
+            raise ValueError(
+                f"--sync_dtype {self.sync_dtype} rides the sharded "
+                "reduce-scatter engine, which applies to --topology "
+                f"allreduce only; got {self.topology!r} (gossip exchanges "
+                "stay dense) — the flags would otherwise be silently "
+                "ignored")
+        if self.sync_compression == "ef" and not compressed_wire:
+            raise ValueError(
+                "--sync_compression ef compensates compressed-wire "
+                "rounding; it requires --sync_dtype bfloat16 or int8")
         if self.sync_bucket_mb <= 0:
             raise ValueError(
                 f"sync_bucket_mb must be positive, got {self.sync_bucket_mb}")
@@ -274,9 +317,25 @@ def build_argparser() -> argparse.ArgumentParser:
                         "O(stages) residual memory)")
     p.add_argument("--pp_remat", action="store_true",
                    default=d.pp_remat,
-                   help="rematerialize each layer under pipeline "
-                        "parallelism (save only layer-boundary "
-                        "activations; ~1/3 extra forward compute)")
+                   help="[compat alias] rematerialize each layer under "
+                        "pipeline parallelism — same as --remat_policy "
+                        "everything")
+    p.add_argument("--layer_scan", type=str, default=d.layer_scan,
+                   choices=["auto", "on", "off"],
+                   help="run homogeneous transformer blocks as a stacked "
+                        "lax.scan (compile once per block, not per layer); "
+                        "auto = on for bert_*/gpt_*/llama_*/vit_*")
+    p.add_argument("--remat_policy", type=str, default=d.remat_policy,
+                   choices=["none", "dots_saveable", "everything"],
+                   help="jax.checkpoint policy for the scanned layer "
+                        "stack: dots_saveable saves matmul outputs, "
+                        "everything rematerializes whole blocks "
+                        "(GPipe-paper memory recipe)")
+    p.add_argument("--grad_accum", type=int, default=d.grad_accum,
+                   help="microbatch gradient accumulation factor: scan K "
+                        "microbatches per step with a donated fp32 grad "
+                        "carry (bounded activation memory, unchanged "
+                        "effective batch and sync cadence)")
     p.add_argument("--num_kv_heads", type=int, default=d.num_kv_heads,
                    help="grouped-query attention kv-head count "
                         "(llama_* models; 0 = multi-head)")
@@ -308,14 +367,16 @@ def build_argparser() -> argparse.ArgumentParser:
                         "(bit-identical to dense in fp32), auto = sharded "
                         "on TPU, dense otherwise")
     p.add_argument("--sync_dtype", type=str, default=d.sync_dtype,
-                   choices=["float32", "bfloat16"],
+                   choices=["float32", "bfloat16", "int8"],
                    help="wire dtype of the sharded sync collectives "
-                        "(bfloat16 halves bytes on the wire)")
+                        "(bfloat16 halves bytes on the wire; int8 + "
+                        "per-bucket scale quarters them)")
     p.add_argument("--sync_compression", type=str,
                    default=d.sync_compression, choices=["none", "ef"],
                    help="ef = carry fp32 error-feedback residuals in train "
-                        "state so bf16 wire rounding does not accumulate "
-                        "into the parameters (weights aggregation)")
+                        "state so compressed wire rounding does not "
+                        "accumulate into the parameters (weights "
+                        "aggregation)")
     p.add_argument("--sync_bucket_mb", type=float, default=d.sync_bucket_mb,
                    help="sharded-sync bucket size in MiB per collective")
     return p

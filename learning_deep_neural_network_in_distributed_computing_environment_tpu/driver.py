@@ -193,7 +193,46 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         raise ValueError(
             f"--pp_remat applies under pipeline parallelism (a '{PIPE_AXIS}' "
             "mesh axis of size >= 2); without one the flag would silently "
-            "do nothing")
+            "do nothing — use --remat_policy with --layer_scan instead")
+    # --- layer-scan compile engine (ISSUE 3) ---------------------------
+    # Resolve --layer_scan: stack the repeated block parameters along a
+    # leading layer axis and run them under lax.scan, so the block
+    # traces/compiles once regardless of depth.  Pipeline parallelism
+    # REQUIRES the stacked structure (the 'pipe' axis shards the layer
+    # dim); auto turns it on for every homogeneous-block family.
+    from .models import supports_layer_scan
+    if cfg.layer_scan == "on" and not supports_layer_scan(cfg.model):
+        raise ValueError(
+            f"--layer_scan on applies to homogeneous-block models "
+            f"(bert_*/gpt_*/llama_*/vit_*); got --model {cfg.model} "
+            "(heterogeneous CNN/MLP layers cannot stack)")
+    if cfg.layer_scan == "off" and pp > 1:
+        raise ValueError(
+            f"--layer_scan off cannot combine with a '{PIPE_AXIS}' mesh "
+            "axis: pipeline parallelism shards the stacked layer axis "
+            "(scan-over-layers IS the pipeline's parameter layout)")
+    layer_scan_on = (pp > 1 or cfg.layer_scan == "on"
+                     or (cfg.layer_scan == "auto"
+                         and supports_layer_scan(cfg.model)))
+    if layer_scan_on:
+        base_kw.update(scan_layers=True)
+    # --remat_policy (the old remat bool, now a named jax.checkpoint
+    # policy); --pp_remat is its "everything" compat alias
+    remat_policy = cfg.remat_policy
+    if cfg.pp_remat and remat_policy == "none":
+        remat_policy = "everything"
+    if remat_policy != "none" and not layer_scan_on:
+        raise ValueError(
+            f"--remat_policy {remat_policy} applies to the scanned layer "
+            "stack (--layer_scan on/auto with a homogeneous-block model, "
+            "or pipeline parallelism); this config runs unrolled")
+    if remat_policy != "none":
+        train_kw.update(remat_policy=remat_policy)
+    if cfg.grad_accum > 1 and not is_attention_model(cfg.model):
+        raise ValueError(
+            f"--grad_accum applies to attention models (bert_*/gpt_*/"
+            f"vit_*/llama_* — no BatchNorm running stats to split across "
+            f"microbatches); got --model {cfg.model}")
     if cfg.pp_schedule == "1f1b":
         if pp <= 1:
             raise ValueError(
@@ -259,8 +298,7 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         from .parallel.pp import pp_param_specs
         base_kw.update(scan_layers=True)
         train_kw.update(pipeline_axis=PIPE_AXIS, pp_size=pp,
-                        num_microbatches=cfg.pp_microbatches,
-                        remat=cfg.pp_remat)
+                        num_microbatches=cfg.pp_microbatches)
         param_specs_fn = partial(pp_param_specs, axis=PIPE_AXIS)
     if cfg.num_kv_heads > 0:
         # grouped-query attention (models/llama.py; the Llama-2/3 recipe)
@@ -384,6 +422,23 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             from functools import partial
             param_specs_fn = partial(fsdp_param_specs, axis=FSDP_AXIS,
                                      axis_size=fsdp)
+    if cfg.grad_accum > 1:
+        # the engine splits the per-DEVICE batch (after any fsdp split)
+        # into grad_accum slices; each slice must still feed the GPipe
+        # microbatch reshape when PP is on — fail fast here, not with an
+        # opaque trace-time reshape error
+        per_dev = cfg.batch_size // max(fsdp, 1)
+        if per_dev % cfg.grad_accum:
+            raise ValueError(
+                f"per-device batch {per_dev} (batch_size {cfg.batch_size}"
+                f"{f' / fsdp {fsdp}' if fsdp > 1 else ''}) must be "
+                f"divisible by --grad_accum {cfg.grad_accum}")
+        if pp > 1 and (per_dev // cfg.grad_accum) % (cfg.pp_microbatches
+                                                     or pp):
+            raise ValueError(
+                f"per-accumulation-slice batch {per_dev // cfg.grad_accum} "
+                f"must be divisible by {cfg.pp_microbatches or pp} "
+                "pipeline microbatches")
     if cfg.sequence_parallel != "none":
         if cfg.attention_impl != "dense":
             raise ValueError(

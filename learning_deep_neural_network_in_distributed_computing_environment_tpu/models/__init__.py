@@ -92,6 +92,15 @@ def is_attention_model(name: str) -> bool:
     return name.lower().startswith(("bert", "gpt", "vit", "llama"))
 
 
+def supports_layer_scan(name: str) -> bool:
+    """True for the homogeneous-block families whose repeated blocks can
+    be stacked along a layer axis and run under ``lax.scan`` (the
+    layer-scan compile engine): every transformer family.  CNN/MLP models
+    have heterogeneous layers (changing widths/strides) that cannot
+    share one stacked parameter block."""
+    return is_attention_model(name)
+
+
 def is_token_model(name: str) -> bool:
     """True for models whose input is a token-id sequence [B, L] — the
     shape sequence parallelism shards.  ViT is attention-based but takes

@@ -197,9 +197,18 @@ class _ScanLayer(nn.Module):
         return y, None
 
 
+def resolve_remat_policy(remat: bool, remat_policy):
+    """Effective named policy from the legacy bool + the named flag:
+    ``remat_policy`` wins when set; ``remat=True`` is the "everything"
+    alias; falsy/"none" means no rematerialization."""
+    if remat_policy and remat_policy != "none":
+        return remat_policy
+    return "everything" if remat else None
+
+
 def apply_scanned_stack(scan_layer_cls, x, *, num_layers: int, pp_size: int,
                         pipeline_axis, num_microbatches: int, train: bool,
-                        remat: bool = False, **layer_kw):
+                        remat_policy=None, **layer_kw):
     """``nn.scan`` the stacked ``layers`` collection and run it plain or as
     a GPipe schedule — shared by BERT/GPT/ViT/Llama.  The stacked
     collection's leading [num_layers] axis is what ``pp_param_specs``
@@ -215,13 +224,17 @@ def apply_scanned_stack(scan_layer_cls, x, *, num_layers: int, pp_size: int,
                          f"by pp_size {pp_size}")
     n_local = num_layers // pp_size
     cls = scan_layer_cls
-    if remat:
-        # rematerialize each layer on the backward pass: only the layer-
-        # boundary activations are saved (the GPipe paper's own memory
-        # recipe), cutting the all-activations-live profile of autodiff-
-        # through-the-schedule by ~the per-layer intermediate count at
-        # ~1/3 extra forward compute
-        cls = nn.remat(scan_layer_cls, prevent_cse=False)
+    if remat_policy and remat_policy != "none":
+        # rematerialize each layer on the backward pass under a named
+        # jax.checkpoint policy: "everything" saves only the layer-
+        # boundary activations (the GPipe paper's own memory recipe,
+        # ~1/3 extra forward compute); "dots_saveable" keeps matmul
+        # outputs and recomputes only the cheap elementwise chains
+        # between them (the pjit/TPUv4 selective-remat default)
+        from ..compat import checkpoint_policy
+        policy = checkpoint_policy(remat_policy)
+        remat_kw = {} if policy is None else {"policy": policy}
+        cls = nn.remat(scan_layer_cls, prevent_cse=False, **remat_kw)
     scanned = nn.scan(
         cls, variable_axes={"params": 0, "aux": 0},
         split_rngs={"params": True}, in_axes=nn.broadcast,
@@ -262,7 +275,8 @@ class BertForMLM(nn.Module):
     pp_size: int = 1               # pipe-axis size (static; local layer
     #                                count = num_layers // pp_size)
     num_microbatches: int = 0      # 0 => pp_size
-    remat: bool = False            # rematerialize each layer (memory)
+    remat: bool = False            # [compat alias] remat_policy="everything"
+    remat_policy: Optional[str] = None  # none | dots_saveable | everything
     num_experts: int = 0           # >0 => MoE FFN in every layer
     expert_axis: Optional[str] = None
     ep_size: int = 1
@@ -344,7 +358,7 @@ class BertForMLM(nn.Module):
         return apply_scanned_stack(
             _ScanLayer, x, num_layers=self.num_layers, pp_size=self.pp_size,
             pipeline_axis=None if as_stage else self.pipeline_axis,
-            remat=self.remat,
+            remat_policy=resolve_remat_policy(self.remat, self.remat_policy),
             num_microbatches=self.num_microbatches, train=train,
             num_heads=self.num_heads, ffn_dim=self.ffn_dim,
             dtype=self.dtype, attention_impl=self.attention_impl,
@@ -414,11 +428,19 @@ def _tp_parts(names: list, ndim: int, axis: str,
 def tp_param_specs(params, axis: str = "model", *,
                    shard_tok_emb: bool = False):
     """PartitionSpec tree sharding BERT parameters over the TP ``axis``
-    (no worker axis — the engine prepends it); pattern in ``_tp_parts``."""
+    (no worker axis — the engine prepends it); pattern in ``_tp_parts``.
+
+    Handles BOTH parameter layouts: unrolled ``layer{i}`` trees and the
+    ``layer_scan`` stacked ``layers`` collection, whose leaves carry a
+    leading [num_layers] dim (unsharded here — ``pp_tp_param_specs`` is
+    the twin that puts it on ``pipe``) with the Megatron pattern applied
+    to the inner dims."""
     from jax.sharding import PartitionSpec as P
 
     def spec(path, leaf):
         names = [getattr(p, "key", str(p)) for p in path]
+        if "layers" in names:
+            return P(None, *_tp_parts(names, leaf.ndim - 1, axis))
         return P(*_tp_parts(names, leaf.ndim, axis,
                             shard_tok_emb=shard_tok_emb))
     return jax.tree_util.tree_map_with_path(spec, params)

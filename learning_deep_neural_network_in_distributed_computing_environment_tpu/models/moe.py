@@ -164,13 +164,17 @@ def with_expert_overlay(specs_fn, *, axis: str = "expert"):
 def ep_param_specs(params, axis: str = "expert"):
     """PartitionSpec tree sharding MoE expert stacks over ``axis`` (no
     worker axis — the engine prepends it): w1/b1/w2/b2 leaves under any
-    ``moe`` submodule get their leading (expert) dim sharded; the gate and
-    everything else replicated."""
+    ``moe`` submodule get their EXPERT dim sharded — the leading dim, or
+    dim 1 under a ``layer_scan`` stacked ``layers`` collection (the layer
+    dim stays unsharded; ``pp_ep_param_specs`` is the twin that puts it
+    on ``pipe``); the gate and everything else replicated."""
     from jax.sharding import PartitionSpec as P
 
     def spec(path, leaf):
         names = [getattr(p_, "key", str(p_)) for p_ in path]
         if "moe" in names and "gate" not in names:
+            if "layers" in names:
+                return P(None, axis, *([None] * (leaf.ndim - 2)))
             return P(axis, *([None] * (leaf.ndim - 1)))
         return P()
     return jax.tree_util.tree_map_with_path(spec, params)

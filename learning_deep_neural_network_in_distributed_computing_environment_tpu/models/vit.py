@@ -71,7 +71,8 @@ class ViT(nn.Module):
     pipeline_axis: Optional[str] = None
     pp_size: int = 1
     num_microbatches: int = 0
-    remat: bool = False
+    remat: bool = False            # [compat alias] remat_policy="everything"
+    remat_policy: Optional[str] = None  # none | dots_saveable | everything
     num_experts: int = 0
     expert_axis: Optional[str] = None
     ep_size: int = 1
@@ -140,11 +141,11 @@ class ViT(nn.Module):
                             jnp.asarray(x, jnp.float32))
 
     def _encode_scanned(self, x, train: bool, as_stage: bool = False):
-        from .bert import apply_scanned_stack
+        from .bert import apply_scanned_stack, resolve_remat_policy
         return apply_scanned_stack(
             _ScanLayer, x, num_layers=self.num_layers, pp_size=self.pp_size,
             pipeline_axis=None if as_stage else self.pipeline_axis,
-            remat=self.remat,
+            remat_policy=resolve_remat_policy(self.remat, self.remat_policy),
             num_microbatches=self.num_microbatches, train=train,
             num_heads=self.num_heads, ffn_dim=self.ffn_dim,
             dtype=self.dtype, attention_impl=self.attention_impl,

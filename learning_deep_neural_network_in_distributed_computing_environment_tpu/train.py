@@ -177,6 +177,18 @@ def _ce_bwd(res, g):
 softmax_cross_entropy.defvjp(_ce_fwd, _ce_bwd)
 
 
+def masked_weights(labels: jnp.ndarray, batch_mask: jnp.ndarray):
+    """Per-position fp32 loss weights: the batch mask broadcast over the
+    label dims, with ignore-index positions (label < 0, the standard
+    convention) zeroed.  THE weight definition for every masked-mean
+    loss in the engine — the token stats, the 1F1B schedule, and the
+    grad-accumulation denominator must agree byte-for-byte or the
+    numerator/denominator constructions silently stop matching."""
+    w = batch_mask.reshape(
+        batch_mask.shape + (1,) * (labels.ndim - batch_mask.ndim))
+    return jnp.broadcast_to(w, labels.shape).astype(jnp.float32) * (labels >= 0)
+
+
 def masked_token_stats(logits: jnp.ndarray, labels: jnp.ndarray,
                        batch_mask: jnp.ndarray):
     """(ce, weight, correct) for classification ([B] labels) and token
@@ -184,9 +196,7 @@ def masked_token_stats(logits: jnp.ndarray, labels: jnp.ndarray,
     the standard ignore-index convention)."""
     labels_safe = jnp.maximum(labels, 0)
     ce = softmax_cross_entropy(logits, labels_safe)
-    w = batch_mask.reshape(
-        batch_mask.shape + (1,) * (labels.ndim - batch_mask.ndim))
-    w = jnp.broadcast_to(w, labels.shape).astype(jnp.float32) * (labels >= 0)
+    w = masked_weights(labels, batch_mask)
     correct = ((logits.argmax(-1) == labels) * w).sum()
     return ce, w, correct
 
@@ -200,16 +210,25 @@ def _tree_where(pred, a: PyTree, b: PyTree) -> PyTree:
         lambda x, y: jnp.where(pred, x, y), a, b)
 
 
-def _zeros_like_varying(tree: PyTree) -> PyTree:
+def _zeros_like_varying(tree: PyTree, dtype=None, extra_axes=()) -> PyTree:
     """``zeros_like`` whose varying-axes type matches each source leaf.
 
     Scan carries under ``shard_map`` must type-match their body outputs
     (parallel/sp.py's accumulator note); a plain ``jnp.zeros_like`` is
     axis-invariant while fsdp-sharded gradient leaves vary over the fsdp
-    axis."""
+    axis.  ``dtype`` overrides the leaf dtype (the grad-accumulation
+    carry widens to fp32).  ``extra_axes`` marks the zeros varying over
+    ADDITIONAL axes beyond the source leaf's — the grad-accumulation
+    carry holds PRE-reduction gradients, which vary over the
+    batch-partial (seq/fsdp) axes that the params are invariant along.
+    Legacy shard_map (no vma typing) ignores both refinements: its
+    check_rep rewrite reconciles carry types itself."""
     def z(x):
-        zz = jnp.zeros_like(x)
-        want = set(getattr(typeof(x), "vma", ()))
+        zz = jnp.zeros(x.shape, dtype or x.dtype)
+        t = typeof(x)
+        if not hasattr(t, "vma"):
+            return zz
+        want = set(t.vma) | set(extra_axes)
         have = set(getattr(typeof(zz), "vma", ()))
         missing = tuple(sorted(want - have))
         return pcast(zz, missing, to="varying") if missing else zz
@@ -359,6 +378,12 @@ class LocalSGDEngine:
                              or int(mesh.shape.get(PIPE_AXIS, 1)) > 1)
             self._check_rep = not (self.seq_axis is not None
                                    and not needs_rewrite)
+        # Microbatch gradient accumulation (ISSUE 3): K > 1 scans the
+        # step's batch in K slices with an fp32 gradient carry — bounded
+        # activation memory, unchanged effective batch/optimizer/sync
+        # cadence.  K == 1 takes the unmodified step path (bit-identical
+        # to the pre-accumulation engine by construction).
+        self.grad_accum = max(1, int(getattr(cfg, "grad_accum", 1)))
         # torch.optim.Adam defaults (betas 0.9/0.999, eps 1e-8); LR applied
         # outside so StepLR can drive it per local epoch.
         self.tx = optax.scale_by_adam(b1=0.9, b2=0.999, eps=1e-8)
@@ -366,9 +391,9 @@ class LocalSGDEngine:
         self._spec = P(DATA_AXIS)
         # --- round-sync engine selection (ISSUE 2) ---------------------
         self.sync_mode = self._resolve_sync_mode()
-        self.sync_wire_dtype = (jnp.bfloat16
-                                if cfg.sync_dtype == "bfloat16"
-                                else jnp.float32)
+        self.sync_wire_dtype = {"bfloat16": jnp.bfloat16,
+                                "int8": jnp.int8}.get(
+                                    cfg.sync_dtype, jnp.float32)
         # error feedback needs per-worker residual state, which only the
         # weights (FedAvg) aggregation carries forward; in gradients mode
         # the aggregate is discarded after its norm, so compression error
@@ -415,12 +440,14 @@ class LocalSGDEngine:
             return "dense"
         if cfg.topology != "allreduce":
             return "dense"
-        if cfg.sync_dtype == "bfloat16":
+        if cfg.sync_dtype in ("bfloat16", "int8"):
             return "sharded"
-        if LEGACY_SHARD_MAP and self._inner_axes:
-            # legacy check_rep's psum_scatter replication tracking is not
-            # exercised under inner axes; the dense path is proven there
-            return "dense"
+        # Inner (TP/PP/EP) mesh axes no longer force the dense path on
+        # legacy JAX: psum_scatter/all_to_all/all_gather over 'data' are
+        # bit-identical to the dense twin under legacy check_rep with the
+        # engine's replication re-certification — verified across
+        # model/pipe/expert inner axes in tests/test_sync.py
+        # (TestShardedSyncInnerAxes).
         return "sharded" if jax.default_backend() == "tpu" else "dense"
 
     def _sync_body(self, params, grads, residual):
@@ -667,7 +694,8 @@ class LocalSGDEngine:
             return vocab_parallel_token_stats(out, yb, mb, self.vp_axis)
         return masked_token_stats(out, yb, mb)
 
-    def _onef1b_loss_and_metrics(self, params, batch_stats, xb, yb, mb):
+    def _onef1b_loss_and_metrics(self, params, batch_stats, xb, yb, mb,
+                                 denom=None, aux_div=1.0):
         """1F1B train-step loss: embeddings and the per-microbatch head +
         CE run through ``parallel.pp.onef1b_loss`` (the fwd+bwd schedule
         as a custom-VJP function), so an outer ``value_and_grad`` over
@@ -675,7 +703,12 @@ class LocalSGDEngine:
         embedding grads flow through the returned input cotangent (tied
         heads — GPT's tok_emb — get both contributions summed by the
         chain rule automatically).  The masked-mean loss stays exact
-        because its denominator is data-derived and computed up front."""
+        because its denominator is data-derived and computed up front.
+
+        ``denom``/``aux_div``: external full-step denominator + aux-loss
+        divisor from the gradient-accumulation wrapper (this call then
+        sees ONE microbatch slice and returns its numerator share —
+        ``_accum_value_and_grad``)."""
         from .parallel.pp import onef1b_loss
         tm = self.train_model
         mnum = tm.num_microbatches or tm.pp_size
@@ -695,12 +728,13 @@ class LocalSGDEngine:
         emb = tm.apply({"params": params}, xb, train=True, mode="embed")
         ys = yb.reshape(mnum, b // mnum, *yb.shape[1:])
         mbs = mb.reshape(mnum, b // mnum, *mb.shape[1:])
-        w = mb.reshape(mb.shape + (1,) * (yb.ndim - mb.ndim))
-        w = jnp.broadcast_to(w, yb.shape).astype(jnp.float32) * (yb >= 0)
+        w = masked_weights(yb, mb)
         ws = w.reshape(mnum, b // mnum, *w.shape[1:])
-        denom = w.sum()
+        external_denom = denom is not None
+        if not external_denom:
+            denom = w.sum()
         part = self._part_axes()
-        if part:
+        if part and not external_denom:
             # the batch is PARTIAL on this device (fsdp slice of the
             # worker batch and/or one seq chunk of every sequence): the
             # masked-mean denominator is global, while each loss_fn
@@ -729,7 +763,9 @@ class LocalSGDEngine:
             # silently drop them); each microbatch contributes 1/m of
             # the full-batch aux scale, further averaged over any
             # batch-partial axes exactly as the standard path does
-            aux_w = self.cfg.moe_aux_weight / mnum
+            # aux_div: the accumulation wrapper averages the per-slice
+            # aux losses over its K microbatches too
+            aux_w = self.cfg.moe_aux_weight / mnum / aux_div
             for ax in part:
                 aux_w = aux_w / self.mesh.shape[ax]
 
@@ -777,10 +813,18 @@ class LocalSGDEngine:
             total = lax.psum(total, part)
         return loss, (batch_stats, correct, total)
 
-    def _loss_and_metrics(self, params, batch_stats, xb, yb, mb):
+    def _loss_and_metrics(self, params, batch_stats, xb, yb, mb,
+                          denom=None, aux_div=1.0):
+        """Step loss + aux metrics.  ``denom`` (full-step masked-weight
+        sum, already psum'd over batch-partial axes and floored at 1) and
+        ``aux_div`` come from the gradient-accumulation wrapper: this
+        call then sees ONE microbatch slice and returns its numerator
+        over the shared denominator, so the K slice losses/grads SUM to
+        the full-batch step's (``_accum_value_and_grad``)."""
         if self.onef1b:
             return self._onef1b_loss_and_metrics(params, batch_stats,
-                                                 xb, yb, mb)
+                                                 xb, yb, mb, denom=denom,
+                                                 aux_div=aux_div)
         if self.fsdp_axis:
             # ZeRO-3: shards -> full params just-in-time; grad of this
             # all_gather is reduce-scatter, so each device's gradient tree
@@ -792,7 +836,19 @@ class LocalSGDEngine:
             mutable=["batch_stats", "aux"])
         ce, w, correct = self._token_stats(out, yb, mb)
         part_axes = self._part_axes()
-        if part_axes:
+        if denom is not None:
+            # accumulation microbatch: local numerator over the external
+            # full-step denominator; correct/total stay slice-local sums
+            # psum'd over batch-partial axes exactly as below, so the
+            # wrapper's running sums match the full-batch step's values
+            if part_axes:
+                w = optimization_barrier((w, ce))[0]
+            loss = (ce * w).sum() / denom
+            total = w.sum()
+            if part_axes:
+                correct = lax.psum(correct, part_axes)
+                total = lax.psum(total, part_axes)
+        elif part_axes:
             # ORDER the mask-only psums below after the model's own
             # collectives: ``w`` derives from the batch mask alone, so its
             # psums are otherwise DAG-independent of the forward pass and
@@ -841,7 +897,10 @@ class LocalSGDEngine:
                 for ax in part_aux:
                     denom_aux = denom_aux * axis_size(ax)
                 a = a / denom_aux
-            loss = loss + self.cfg.moe_aux_weight * a
+            # aux_div: the accumulation wrapper averages the K per-slice
+            # aux losses (per-slice routing/capacity — the same declared
+            # semantics shift as per-microbatch routing under GPipe)
+            loss = loss + self.cfg.moe_aux_weight * a / aux_div
         new_bs = mut.get("batch_stats", batch_stats)
         if self.fsdp_axis and jax.tree_util.tree_leaves(new_bs):
             # BatchNorm under FSDP: each device normalized its sub-batch
@@ -850,6 +909,57 @@ class LocalSGDEngine:
             # the fsdp axis
             new_bs = lax.pmean(new_bs, self.fsdp_axis)
         return loss, (new_bs, correct, total)
+
+    def _accum_value_and_grad(self, params, batch_stats, xb, yb, mb):
+        """Microbatch gradient accumulation (ISSUE 3): split the step's
+        batch into ``grad_accum`` slices and ``lax.scan`` them with an
+        fp32 gradient carry (donated in place by XLA's loop buffer
+        reuse), so peak activation memory is that of ONE slice.
+
+        Exactness: the full-step masked-weight denominator is computed up
+        front (psum'd over batch-partial axes like the standard path), so
+        each slice returns its loss NUMERATOR over the shared denominator
+        and its gradient — both of which SUM over slices to the
+        full-batch step's values, up to fp32 summation order.  Returns
+        the same ``((loss, (batch_stats, correct, total)), grads)``
+        contract as the K=1 ``value_and_grad`` call."""
+        k = self.grad_accum
+        b = xb.shape[0]
+        xs = xb.reshape(k, b // k, *xb.shape[1:])
+        ys = yb.reshape(k, b // k, *yb.shape[1:])
+        ms = mb.reshape(k, b // k, *mb.shape[1:])
+        denom = masked_weights(yb, mb).sum()
+        part = self._part_axes()
+        if part:
+            denom = lax.psum(denom, part)
+            # ORDER this mask-only psum before the model collectives of
+            # every slice (same XLA:CPU rendezvous hazard the standard
+            # path barriers at its metrics psum; free on TPU)
+            xs = optimization_barrier((xs, denom))[0]
+        denom = jnp.maximum(denom, 1.0)
+
+        def micro(g, inp):
+            x_k, y_k, m_k = inp
+            (loss_k, (_bs, c_k, t_k)), g_k = jax.value_and_grad(
+                self._loss_and_metrics, has_aux=True)(
+                    params, batch_stats, x_k, y_k, m_k,
+                    denom=denom, aux_div=float(k))
+            g = jax.tree_util.tree_map(
+                lambda a, d: a + d.astype(jnp.float32), g, g_k)
+            # the scalars ride as stacked scan OUTPUTS — ys have no
+            # carry type-matching constraint on either runtime — and
+            # sum after the loop
+            return g, (loss_k, c_k, t_k)
+
+        zeros = _zeros_like_varying(params, dtype=jnp.float32,
+                                    extra_axes=part)
+        grads, (losses, corrects, totals) = lax.scan(
+            micro, zeros, (xs, ys, ms))
+        loss, correct, total = losses.sum(), corrects.sum(), totals.sum()
+        # batch_stats pass through unchanged: accumulation is gated to
+        # models without BatchNorm (driver validates), so the tree is
+        # empty and the step's _tree_where keeps it as-is
+        return (loss, (batch_stats, correct, total)), grads
 
     def _make_step_fns(self, augment: bool):
         """The shared per-batch bodies: one SGD step and one eval step.
@@ -869,9 +979,15 @@ class LocalSGDEngine:
                     k = jax.random.fold_in(
                         k, lax.axis_index(self.fsdp_axis))
                 xb = augment_batch(k, xb)
-            (loss, (new_bs, correct, total)), grads = jax.value_and_grad(
-                self._loss_and_metrics, has_aux=True)(
-                    params, batch_stats, xb, yb, mb)
+            if self.grad_accum > 1:
+                (loss, (new_bs, correct, total)), grads = \
+                    self._accum_value_and_grad(params, batch_stats,
+                                               xb, yb, mb)
+            else:
+                (loss, (new_bs, correct, total)), grads = \
+                    jax.value_and_grad(
+                        self._loss_and_metrics, has_aux=True)(
+                            params, batch_stats, xb, yb, mb)
             if self.seq_axis:
                 # combine per-chunk grad contributions; params (and the
                 # Adam update below) stay replicated along seq

@@ -55,10 +55,30 @@ def setup_compile_cache(cache_dir: str,
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           min_compile_secs)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        reset_cache_latch()
         install_cache_counter()
         return True
     except Exception:  # noqa: BLE001 — optimization only
         return False
+
+
+def reset_cache_latch() -> None:
+    """Un-latch jax's persistent compilation cache so the NEXT compile
+    re-reads the current config.
+
+    jax latches the cache at the FIRST compile: the cache object (present
+    or absent) is initialized once and the config dir is never consulted
+    again — so arming the cache mid-process (library callers, tests, the
+    bench CLI after warmup compiles), re-pointing it at a different
+    directory, or disabling it for a timing section are all silent no-ops
+    without this.  Safe no-op when the internals drift across versions."""
+    try:
+        from jax._src import compilation_cache as _cc
+        if getattr(_cc, "_cache_initialized", False) \
+                or getattr(_cc, "_cache_checked", False):
+            _cc.reset_cache()
+    except Exception:  # noqa: BLE001 — optimization only
+        pass
 
 
 # --- persistent-cache hit/miss telemetry (ROADMAP open item) ---------------

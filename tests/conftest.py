@@ -34,6 +34,19 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Opt-in session-persistent XLA compile cache (ISSUE 3 satellite): point
+# JAX_GRAFT_TEST_COMPILE_CACHE at a directory (e.g. .jax_cache/tests) and
+# repeated suite runs on one host stop re-paying the round-program
+# compiles that dominate tier-1 wall.  Opt-in because a cache shared
+# across code revisions can mask compile-path regressions — CI tiers that
+# only gate on numerics should set it, compile-timing work must not.
+_test_cache = os.environ.get("JAX_GRAFT_TEST_COMPILE_CACHE", "")
+if _test_cache:
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu.xla_flags import (  # noqa: E402
+        setup_compile_cache,
+    )
+    setup_compile_cache(_test_cache, min_compile_secs=0.5)
+
 # JAX-version compat: publishes jax.shard_map / jax.typeof / lax.pcast /
 # lax.axis_size shims on legacy runtimes (e.g. 0.4.x) before any test
 # references them directly

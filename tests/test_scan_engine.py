@@ -1,0 +1,329 @@
+"""Layer-scan compile engine (ISSUE 3 tentpole).
+
+Covers the contract end to end: the scanned stack TRACES its block once
+(not once per layer — the compile-count regression the engine exists
+for), compiles to exactly one cached executable (asserted via the PR 2
+persistent-cache counter), and computes the bit-identical forward to the
+unrolled twin on transplanted parameters; the named remat policies
+shrink the autodiff residuals monotonically while preserving numerics;
+microbatch gradient accumulation matches the full-batch step within fp32
+summation tolerance at K in {2, 4} and IS the unmodified step at K=1;
+and the driver wires/validates the --layer_scan / --remat_policy /
+--grad_accum surface.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import get_model
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.train import LocalSGDEngine
+
+VOCAB, L_SEQ, DEPTH = 97, 16, 4
+
+
+def tokens(b=2, seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.integers(0, VOCAB, (b, L_SEQ)), jnp.int32)
+
+
+def build(scan, depth=DEPTH, **kw):
+    return get_model("gpt_tiny", num_classes=VOCAB, num_layers=depth,
+                     max_len=L_SEQ, scan_layers=scan, **kw)
+
+
+def transplant(unrolled_params, depth=DEPTH):
+    """Unrolled ``layer{i}`` subtrees -> the scanned stacked layout."""
+    stacked = jax.tree_util.tree_map(
+        lambda *ls: jnp.stack(ls),
+        *[unrolled_params[f"layer{i}"] for i in range(depth)])
+    out = {k: v for k, v in unrolled_params.items()
+           if not k.startswith("layer")}
+    out["layers"] = {"layer": stacked}
+    return out
+
+
+class TestTraceCount:
+    """The compile-cost mechanism itself: under ``nn.scan`` the block
+    body is traced ONCE regardless of depth; unrolled, once per layer."""
+
+    def _count_block_traces(self, scan, depth):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import gpt
+
+        calls = {"n": 0}
+        orig = gpt.GPTBlock.__call__
+
+        def counting(self, *a, **kw):
+            calls["n"] += 1
+            return orig(self, *a, **kw)
+
+        gpt.GPTBlock.__call__ = counting
+        try:
+            m = build(scan, depth)
+            x = tokens()
+            params = m.init(jax.random.key(0), x, train=False)["params"]
+            calls["n"] = 0
+            jax.make_jaxpr(
+                lambda p: m.apply({"params": p}, x, train=True))(params)
+        finally:
+            gpt.GPTBlock.__call__ = orig
+        return calls["n"]
+
+    def test_scanned_trace_count_is_depth_independent(self):
+        # nn.scan traces the block a small CONSTANT number of times
+        # (once to lift variables, once for the jaxpr); unrolled, the
+        # count is the layer count — the linear-in-depth compile cost
+        # the engine removes
+        scan4 = self._count_block_traces(scan=True, depth=DEPTH)
+        scan8 = self._count_block_traces(scan=True, depth=2 * DEPTH)
+        assert scan4 == scan8 <= 2, (scan4, scan8)
+        assert self._count_block_traces(scan=False, depth=DEPTH) == DEPTH
+        assert self._count_block_traces(
+            scan=False, depth=2 * DEPTH) == 2 * DEPTH
+
+    def test_one_cached_executable_for_the_stack(self, tmp_path):
+        """ONE jit entry for the whole scanned stack, via the PR 2
+        persistent-cache counter: compiling the scanned train forward
+        registers exactly one cache miss (one executable), and an
+        identical fresh jit is served as one hit."""
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.xla_flags import (
+            compile_cache_counts,
+            setup_compile_cache,
+        )
+        if not setup_compile_cache(str(tmp_path), min_compile_secs=0.0):
+            pytest.skip("persistent compile cache unavailable")
+        try:
+            m = build(True, depth=8)
+            x = tokens()
+            params = jax.jit(
+                lambda k: m.init(k, x, train=False))(jax.random.key(0))
+            before = compile_cache_counts()
+            jax.jit(lambda p: m.apply(p, x, train=True)).lower(
+                params).compile()
+            mid = compile_cache_counts()
+            assert mid["misses"] - before["misses"] == 1
+            # a DISTINCT function object with the identical HLO: jax's
+            # in-memory executable dedupe cannot serve it, so the compile
+            # goes to the persistent cache and must HIT
+            jax.jit(lambda p: m.apply(p, x, train=True)).lower(
+                params).compile()
+            after = compile_cache_counts()
+            assert after["hits"] - mid["hits"] == 1
+            assert after["misses"] == mid["misses"]
+        finally:
+            # un-latch the tmp cache (jax initializes the cache object
+            # once — clearing the config dir alone would leave every
+            # later compile in this process hitting the tmp cache:
+            # phantom hit/miss deltas in the driver-telemetry tests
+            # downstream), then RESTORE the session cache if the suite
+            # opted into one via JAX_GRAFT_TEST_COMPILE_CACHE
+            import os
+
+            from learning_deep_neural_network_in_distributed_computing_environment_tpu.xla_flags import (
+                reset_cache_latch,
+            )
+            session_dir = os.environ.get("JAX_GRAFT_TEST_COMPILE_CACHE", "")
+            if session_dir:
+                setup_compile_cache(session_dir, min_compile_secs=0.5)
+            else:
+                jax.config.update("jax_compilation_cache_dir", None)
+                reset_cache_latch()
+
+
+class TestScanVsUnrolled:
+    def test_forward_bitwise_on_transplanted_params(self):
+        mu, ms = build(False), build(True)
+        x = tokens()
+        pu = mu.init(jax.random.key(1), x, train=False)["params"]
+        pt = transplant(pu)
+        # compare the COMPILED programs (what training runs): eager
+        # op-by-op dispatch fuses differently and drifts ~1e-7
+        ou = jax.jit(lambda p: mu.apply({"params": p}, x, train=True))(pu)
+        os_ = jax.jit(lambda p: ms.apply({"params": p}, x, train=True))(pt)
+        assert np.array_equal(np.asarray(ou), np.asarray(os_))
+
+    def test_grads_match_within_float_rounding(self):
+        mu, ms = build(False), build(True)
+        x = tokens()
+        pu = mu.init(jax.random.key(1), x, train=False)["params"]
+        pt = transplant(pu)
+
+        def loss(m, p):
+            return (m.apply({"params": p}, x,
+                            train=True).astype(jnp.float32) ** 2).sum()
+
+        gu = jax.grad(lambda p: loss(mu, p))(pu)
+        gs = jax.grad(lambda p: loss(ms, p))(pt)
+        gus = transplant(gu)
+        for a, b in zip(jax.tree_util.tree_leaves(gus),
+                        jax.tree_util.tree_leaves(gs)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-5, atol=1e-5)
+
+
+class TestRematPolicies:
+    def test_residuals_shrink_monotonically_numerics_hold(self):
+        """dots_saveable keeps matmul outputs (fewer residual bytes than
+        no-remat), everything keeps only block boundaries (fewest);
+        all three compute the same function."""
+        x = tokens(b=4)
+        outs, sizes = {}, {}
+        params = None
+        for policy in ("none", "dots_saveable", "everything"):
+            m = build(True, remat_policy=policy)
+            if params is None:
+                params = m.init(jax.random.key(0), x,
+                                train=False)["params"]
+            out, vjp_fn = jax.vjp(
+                lambda p: m.apply({"params": p}, x, train=True), params)
+            outs[policy] = out
+            sizes[policy] = sum(l.nbytes for l in
+                                jax.tree_util.tree_leaves(vjp_fn))
+        np.testing.assert_allclose(outs["dots_saveable"], outs["none"],
+                                   atol=1e-6)
+        np.testing.assert_allclose(outs["everything"], outs["none"],
+                                   atol=1e-6)
+        assert sizes["everything"] < sizes["dots_saveable"] < sizes["none"], \
+            sizes
+
+    def test_legacy_remat_bool_is_everything_alias(self):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.models.bert import (
+            resolve_remat_policy,
+        )
+        assert resolve_remat_policy(True, None) == "everything"
+        assert resolve_remat_policy(False, None) is None
+        assert resolve_remat_policy(False, "none") is None
+        assert resolve_remat_policy(True, "dots_saveable") == "dots_saveable"
+
+
+class TestGradAccum:
+    """--grad_accum K: scan K microbatches with an fp32 grad carry.
+    K in {2, 4} matches the full-batch round within fp32 summation
+    tolerance; K=1 takes the UNMODIFIED step path (bit-identical by
+    construction, asserted through the round program)."""
+
+    def _round(self, mesh, grad_accum):
+        cfg = Config(model="gpt_tiny", dataset="synthetic_lm",
+                     epochs_local=1, batch_size=8,
+                     compute_dtype="float32", augment=False,
+                     aggregation_by="weights", grad_accum=grad_accum)
+        model = get_model("gpt_tiny", num_classes=VOCAB, max_len=L_SEQ)
+        engine = LocalSGDEngine(model, mesh, cfg)
+        rng = np.random.default_rng(0)
+        n, s, b = 2, 2, 8
+        x = rng.integers(0, VOCAB, (n, s, b, L_SEQ)).astype(np.int32)
+        y = rng.integers(0, VOCAB, (n, s, b, L_SEQ)).astype(np.int32)
+        m = np.ones((n, s, b), np.float32)
+        state = engine.init_state(jax.random.key(0), x[0, 0])
+        state, mx = engine.round(state, (x, y, m),
+                                 (x[:, :1], y[:, :1], m[:, :1]))
+        return state, mx
+
+    @pytest.fixture(scope="class")
+    def mesh2(self, devices):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu import (
+            mesh as mesh_lib,
+        )
+        return mesh_lib.build_mesh({"data": 2}, devices=devices[:2])
+
+    def test_accumulation_matches_full_batch(self, mesh2):
+        base_state, base_mx = self._round(mesh2, grad_accum=1)
+        for k in (2, 4):
+            state, mx = self._round(mesh2, grad_accum=k)
+            np.testing.assert_allclose(
+                np.asarray(mx["train_loss"]),
+                np.asarray(base_mx["train_loss"]), rtol=0, atol=5e-6,
+                err_msg=f"grad_accum={k}")
+            for a, b in zip(jax.tree_util.tree_leaves(base_state.params),
+                            jax.tree_util.tree_leaves(state.params)):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-4, atol=2e-5,
+                                           err_msg=f"grad_accum={k}")
+
+    def test_masked_batches_keep_denominator_semantics(self, mesh2):
+        """Partially-masked steps: the accumulation denominator is the
+        FULL-step masked weight, so uneven per-slice masses still sum to
+        the full-batch masked mean."""
+        cfg1 = Config(model="gpt_tiny", dataset="synthetic_lm",
+                      epochs_local=1, batch_size=8,
+                      compute_dtype="float32", augment=False,
+                      aggregation_by="weights", grad_accum=1)
+        cfg2 = cfg1.replace(grad_accum=2)
+        model = get_model("gpt_tiny", num_classes=VOCAB, max_len=L_SEQ)
+        rng = np.random.default_rng(1)
+        n, s, b = 2, 1, 8
+        x = rng.integers(0, VOCAB, (n, s, b, L_SEQ)).astype(np.int32)
+        y = rng.integers(0, VOCAB, (n, s, b, L_SEQ)).astype(np.int32)
+        m = np.ones((n, s, b), np.float32)
+        m[:, :, 5:] = 0.0  # slice 2 of K=2 is 3/4 padding
+        outs = {}
+        for cfg in (cfg1, cfg2):
+            engine = LocalSGDEngine(model, mesh2, cfg)
+            state = engine.init_state(jax.random.key(0), x[0, 0])
+            _, mx = engine.round(state, (x, y, m), (x, y, m))
+            outs[cfg.grad_accum] = np.asarray(mx["train_loss"])
+        np.testing.assert_allclose(outs[2], outs[1], rtol=0, atol=5e-6)
+
+
+class TestDriverSurface:
+    def _cfg(self, **kw):
+        base = dict(model="gpt_tiny", dataset="synthetic_lm",
+                    limit_train_samples=64, limit_eval_samples=16,
+                    augment=False)
+        base.update(kw)
+        return Config(**base)
+
+    def _expect_raises(self, mesh_axes, match, **kw):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu import (
+            mesh as mesh_lib,
+        )
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import (
+            train_global,
+        )
+        mesh = mesh_lib.build_mesh(mesh_axes)
+        with pytest.raises(ValueError, match=match):
+            train_global(self._cfg(**kw), mesh=mesh, progress=False)
+
+    def test_layer_scan_on_rejects_heterogeneous_models(self):
+        self._expect_raises({"data": 2}, "homogeneous",
+                            model="mlp", dataset="mnist", layer_scan="on")
+
+    def test_layer_scan_off_rejects_pipe_axis(self):
+        self._expect_raises({"data": 2, "pipe": 2}, "layer_scan off",
+                            layer_scan="off")
+
+    def test_remat_policy_requires_scanned_stack(self):
+        self._expect_raises({"data": 2}, "remat_policy",
+                            model="mlp", dataset="mnist",
+                            remat_policy="dots_saveable")
+
+    def test_grad_accum_rejects_batchnorm_models(self):
+        self._expect_raises({"data": 2}, "grad_accum",
+                            model="enhanced_cnn", dataset="cifar10",
+                            batch_size=8, grad_accum=2)
+
+    def test_grad_accum_must_divide_batch(self):
+        with pytest.raises(ValueError, match="grad_accum"):
+            Config(batch_size=8, grad_accum=3)
+
+    def test_pp_remat_without_pipe_axis_points_at_remat_policy(self):
+        self._expect_raises({"data": 2}, "remat_policy", pp_remat=True)
+
+    def test_auto_scan_stacks_attention_models(self, mesh8):
+        """The auto default: a driver-built attention model carries the
+        stacked ``layers`` collection (and the engine state mirrors it)."""
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import (
+            train_global,
+        )
+        res = train_global(
+            self._cfg(epochs_global=1, epochs_local=1, batch_size=8,
+                      compute_dtype="float32",
+                      aggregation_by="weights"),
+            mesh=mesh8, progress=False,
+            simulated_durations=np.ones(8))
+        assert "layers" in res["state"].params
+        assert not any(k.startswith("layer0")
+                       for k in res["state"].params)
