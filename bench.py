@@ -536,30 +536,25 @@ def measure_flash_one_l(L: int, B: int) -> dict:
     }
 
 
-def measure_sync() -> dict:
-    """Dense vs sharded vs bf16-compressed round-sync A/B (ISSUE 2).
-
-    Times the three stand-alone sync programs (``comms.make_host_sync``)
-    over a worker-stacked, unevenly-shaped ~4 MB parameter pytree on the
-    full device mesh, and reports per-worker bytes-on-the-wire from the
-    shared bucket-plan accounting: dense injects the full replicated
-    buffer per worker; sharded sends 2(N-1)/N of each padded bucket
-    (reduce-scatter + all-gather phases); compressed halves that again
-    (bf16 wire).  Also asserts the fp32 sharded result is BIT-IDENTICAL
-    to dense and reports the compressed path's max deviation.
-    """
+def _sync_bench_fixtures():
+    """The shared `--entry sync` / `--entry gossip` workload: a
+    worker-stacked, unevenly-shaped ~2.5 MB fp32 pytree (622k elements —
+    one bucket at the default 4 MiB target) on the full device mesh;
+    leaf sizes are not divisible by the worker count, so bucket
+    packing/padding is exercised.  Also returns a zero residual and
+    per-worker ShapeDtypeStructs for the wire accounting.  ONE
+    definition keeps the two entries' numbers comparable — the gossip
+    docstring's "same tree as --entry sync" is structural, not a promise
+    to keep two literals in sync."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from learning_deep_neural_network_in_distributed_computing_environment_tpu import comms
     from learning_deep_neural_network_in_distributed_computing_environment_tpu.mesh import build_mesh
 
     n = len(jax.devices())
     mesh = build_mesh({"data": n})
     rng = np.random.default_rng(0)
-    # uneven shapes: exercises bucket packing + padding (sizes not
-    # divisible by n); ~1M fp32 elements total
     shapes = {"emb": (1999, 128), "w1": (128, 1024), "b1": (1031,),
               "w2": (1024, 128), "head": (257, 399), "scale": (7,)}
     tree = {k: jnp.asarray(rng.normal(size=(n, *s)), jnp.float32)
@@ -568,17 +563,47 @@ def measure_sync() -> dict:
     per_worker = {k: jax.ShapeDtypeStruct(s, jnp.float32)
                   for k, s in shapes.items()}
     elems = sum(int(np.prod(s)) for s in shapes.values())
+    return n, mesh, shapes, tree, res0, per_worker, elems
+
+
+def _time_host_sync(fn, tree, residual, reps=7):
+    """Median wall of one jitted host-sync program: compile + warm on the
+    first call, then ``reps`` timed dispatches."""
+    import jax
+
+    out = fn(tree, residual)   # compile + warm
+    jax.block_until_ready(out)
+    samples = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(tree, residual))
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    return out, samples[len(samples) // 2]
+
+
+def measure_sync() -> dict:
+    """Dense vs sharded vs bf16-compressed round-sync A/B (ISSUE 2).
+
+    Times the three stand-alone sync programs (``comms.make_host_sync``)
+    over the shared ``_sync_bench_fixtures`` pytree, and reports
+    per-worker bytes-on-the-wire from the shared bucket-plan accounting:
+    dense injects the full replicated buffer per worker; sharded sends
+    2(N-1)/N of each padded bucket (reduce-scatter + all-gather phases);
+    compressed halves that again (bf16 wire).  Also asserts the fp32
+    sharded result is BIT-IDENTICAL to dense and reports the compressed
+    path's max deviation.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu import comms
+
+    n, mesh, shapes, tree, res0, per_worker, elems = _sync_bench_fixtures()
 
     def time_sync(fn, residual):
-        out = fn(tree, residual)   # compile + warm
-        jax.block_until_ready(out)
-        samples = []
-        for _ in range(7):
-            t0 = time.perf_counter()
-            jax.block_until_ready(fn(tree, residual))
-            samples.append(time.perf_counter() - t0)
-        samples.sort()
-        return out, samples[len(samples) // 2]
+        return _time_host_sync(fn, tree, residual)
 
     dense_fn = comms.make_host_sync(mesh, mode="dense")
     sharded_fn = comms.make_host_sync(mesh, mode="sharded")
@@ -611,6 +636,84 @@ def measure_sync() -> dict:
         "bitwise_sharded_eq_dense": bool(bitwise),
         "compressed_max_abs_err": max_err,
     }
+
+
+def measure_gossip() -> dict:
+    """Dense vs bucketed vs compressed GOSSIP round-sync A/B (ISSUE 4).
+
+    For each gossip topology (ring, double_ring), times the stand-alone
+    sync programs (``comms.make_host_sync``) over the same
+    ``_sync_bench_fixtures`` pytree as ``--entry sync``: the legacy
+    dense per-leaf path (one ppermute per leaf per hop), the bucketed
+    engine (one ppermute per bucket per hop — same bytes, far fewer
+    collectives), and the bf16/int8 compressed wires (1/2 and 1/4 of the
+    fp32 bytes).  Asserts the fp32 bucketed result is BIT-IDENTICAL to
+    dense; the ``collectives`` counts are read from the LOWERED programs
+    (``jit(...).lower(...).as_text()`` collective-permute ops), so they
+    report what each engine actually issues, not what the bucket plan
+    implies.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu import comms
+
+    n, mesh, shapes, tree, res0, per_worker, elems = _sync_bench_fixtures()
+
+    def time_sync(fn, residual):
+        return _time_host_sync(fn, tree, residual, reps=5)
+
+    def count_permutes(fn):
+        txt = jax.jit(lambda t: fn(t, None)).lower(tree).as_text()
+        return (txt.count("collective_permute")
+                + txt.count("collective-permute"))
+
+    def max_err(a, b):
+        return max(float(np.abs(np.asarray(a[k], np.float32)
+                                - np.asarray(b[k], np.float32)).max())
+                   for k in shapes)
+
+    out: dict = {"n_workers": n, "param_mb": round(4 * elems / 1e6, 2)}
+    for topo in ("ring", "double_ring"):
+        dense_fn = comms.make_host_sync(mesh, mode="dense", topology=topo)
+        buck_fn = comms.make_host_sync(mesh, mode="gossip", topology=topo)
+        bf16_fn = comms.make_host_sync(mesh, mode="gossip", topology=topo,
+                                       wire_dtype=jnp.bfloat16)
+        int8_fn = comms.make_host_sync(mesh, mode="gossip", topology=topo,
+                                       wire_dtype=jnp.int8)
+        (dense_out, _), dense_s = time_sync(dense_fn, None)
+        (buck_out, _), buck_s = time_sync(buck_fn, None)
+        (bf16_out, _), bf16_s = time_sync(bf16_fn, res0)
+        (int8_out, _), int8_s = time_sync(int8_fn, res0)
+        wire = lambda wdt: comms.sync_wire_bytes(
+            per_worker, n, mode="gossip", wire_dtype=wdt, topology=topo)
+        b_dense = comms.sync_wire_bytes(per_worker, n, mode="dense",
+                                        topology=topo)
+        b_fp32, b_bf16, b_int8 = (wire(jnp.float32), wire(jnp.bfloat16),
+                                  wire(jnp.int8))
+        out[topo] = {
+            "dense": {"ms": round(dense_s * 1e3, 3),
+                      "wire_mb": round(b_dense / 1e6, 3),
+                      "collectives": count_permutes(dense_fn)},
+            "bucketed": {"ms": round(buck_s * 1e3, 3),
+                         "wire_mb": round(b_fp32 / 1e6, 3),
+                         "collectives": count_permutes(buck_fn)},
+            "bf16": {"ms": round(bf16_s * 1e3, 3),
+                     "wire_mb": round(b_bf16 / 1e6, 3)},
+            "int8": {"ms": round(int8_s * 1e3, 3),
+                     "wire_mb": round(b_int8 / 1e6, 3)},
+            "bitwise_bucketed_eq_dense": bool(all(
+                np.array_equal(np.asarray(dense_out[k]),
+                               np.asarray(buck_out[k])) for k in shapes)),
+            "bf16_vs_fp32_bytes": (round(b_bf16 / b_fp32, 4)
+                                   if b_fp32 else None),
+            "int8_vs_fp32_bytes": (round(b_int8 / b_fp32, 4)
+                                   if b_fp32 else None),
+            "bf16_max_abs_err": max_err(bf16_out, dense_out),
+            "int8_max_abs_err": max_err(int8_out, dense_out),
+        }
+    return out
 
 
 def measure_compile() -> dict:
@@ -946,6 +1049,7 @@ SHORT = {
     "flash_attention": "flash",
     "round_gap": "rgap",
     "sync_collectives": "sync",
+    "gossip_collectives": "gossip",
     "compile_engine": "compile",
 }
 
@@ -973,6 +1077,8 @@ def _run_entry(key: str, entry_budget: float | None = None) -> dict:
         return measure_round_gap()
     if key == "sync_collectives":
         return measure_sync()
+    if key == "gossip_collectives":
+        return measure_gossip()
     if key == "compile_engine":
         return measure_compile()
     for k, name, shape, batch, steps, ncls, tok, _tmo, *extra in LADDER:
@@ -1054,6 +1160,19 @@ def _emit_headline(details: dict, extra: dict) -> None:
                      "cp": (e.get("compressed") or {}).get("ms"),
                      "ratio": e.get("sharded_vs_dense_bytes"),
                      "same": 1 if e.get("bitwise_sharded_eq_dense") else 0}
+        elif key == "gossip_collectives":
+            def _gossip_cell(row):
+                if not isinstance(row, dict):
+                    return None
+                return {"dn": (row.get("dense") or {}).get("ms"),
+                        "bk": (row.get("bucketed") or {}).get("ms"),
+                        "coll": [(row.get("dense") or {}).get("collectives"),
+                                 (row.get("bucketed") or {}).get(
+                                     "collectives")],
+                        "same": 1 if row.get("bitwise_bucketed_eq_dense")
+                        else 0}
+            d[sk] = {"ring": _gossip_cell(e.get("ring")),
+                     "dring": _gossip_cell(e.get("double_ring"))}
         elif key == "compile_engine":
             d[sk] = {"x": e.get("compile_speedup_L8"),
                      "unr": e.get("compile_unrolled_L8_s"),
@@ -1161,11 +1280,11 @@ def main() -> None:
     if not fast:
         at = next(i for i, (k, _t) in enumerate(jobs)
                   if k.startswith("vit_"))
-        # round_gap (the overlapped-pipeline host-gap A/B), the sync-
-        # collective A/B, + per-L flash units run before the sacrificial
-        # ViT tail
+        # round_gap (the overlapped-pipeline host-gap A/B), the sync- and
+        # gossip-collective A/Bs, + per-L flash units run before the
+        # sacrificial ViT tail
         jobs[at:at] = ([("round_gap", 150), ("sync_collectives", 120),
-                        ("compile_engine", 150)]
+                        ("gossip_collectives", 120), ("compile_engine", 150)]
                        + [(f"flash:L{L}", t) for L, _b, t in FLASH_POINTS])
     for key, tmo in jobs:
         rem = _remaining()

@@ -144,19 +144,21 @@ class Config:
     # CLI defaults this to .jax_cache so bench/multi-run invocations on
     # one host stop paying recompiles; library/test callers opt in.
     compile_cache_dir: str = ""
-    # --- round-sync engine (sharded reduce-scatter collectives) ------------
-    # sync_mode: how the once-per-round parameter/gradient aggregation runs
-    # for the allreduce topology.  "sharded" = flatten-and-bucket ->
-    # psum_scatter -> scale the 1/N shard -> all_gather (bit-identical to
-    # dense in fp32); "dense" = per-leaf pmean/psum; "auto" = sharded on
-    # TPU (and whenever compression is requested), dense otherwise.
-    # Ring/double-ring gossip topologies always run dense (they are
-    # neighbor exchanges, not reductions).
+    # --- round-sync engine (bucketed collectives) --------------------------
+    # sync_mode: how the once-per-round parameter/gradient aggregation
+    # runs.  "sharded" selects the bucketed fast engine for the CONFIGURED
+    # topology: flatten-and-bucket -> psum_scatter -> scale the 1/N shard
+    # -> all_gather for allreduce, and flatten-and-bucket -> per-bucket
+    # ppermute hops -> local fp32 blend for ring/double-ring gossip (both
+    # bit-identical to dense in fp32).  "dense" = the legacy per-leaf
+    # pmean/psum/ppermute path; "auto" = the fast engine on TPU (and
+    # whenever compression is requested), dense otherwise.  The resolution
+    # is per topology — see ``resolve_sync_mode``.
     sync_mode: str = "auto"          # auto | dense | sharded
-    # Wire dtype of the sharded sync collectives.  bfloat16 halves the
-    # bytes on the wire; int8 quarters them (per-bucket fp32 scale,
-    # symmetric round-to-nearest — the second compression tier); fp32
-    # keeps the bit-identical-to-dense guarantee.
+    # Wire dtype of the bucketed sync collectives (allreduce AND gossip).
+    # bfloat16 halves the bytes on the wire; int8 quarters them
+    # (per-bucket fp32 scale, symmetric round-to-nearest — the second
+    # compression tier); fp32 keeps the bit-identical-to-dense guarantee.
     sync_dtype: str = "float32"      # float32 | bfloat16 | int8
     # Compression error handling for compressed sync_dtype: "ef" carries
     # fp32 error-feedback residuals in the train state (weights mode), so
@@ -190,16 +192,9 @@ class Config:
         compressed_wire = self.sync_dtype in ("bfloat16", "int8")
         if compressed_wire and self.sync_mode == "dense":
             raise ValueError(
-                f"--sync_dtype {self.sync_dtype} is the sharded engine's "
+                f"--sync_dtype {self.sync_dtype} is the bucketed engines' "
                 "compressed wire format; it cannot combine with "
                 "--sync_mode dense")
-        if compressed_wire and self.topology != "allreduce":
-            raise ValueError(
-                f"--sync_dtype {self.sync_dtype} rides the sharded "
-                "reduce-scatter engine, which applies to --topology "
-                f"allreduce only; got {self.topology!r} (gossip exchanges "
-                "stay dense) — the flags would otherwise be silently "
-                "ignored")
         if self.sync_compression == "ef" and not compressed_wire:
             raise ValueError(
                 "--sync_compression ef compensates compressed-wire "
@@ -215,6 +210,28 @@ class Config:
     # Convenience ----------------------------------------------------------
     def replace(self, **kw: Any) -> "Config":
         return dataclasses.replace(self, **kw)
+
+    def resolve_sync_mode(self, backend: str) -> str:
+        """Resolve ``--sync_mode`` per topology into the engine actually
+        run: ``dense`` | ``sharded`` | ``gossip``.
+
+        ``sharded`` names the bucketed fast engine, whatever the
+        topology: the reduce-scatter/all-gather program for allreduce,
+        the per-bucket ppermute gossip program for ring/double-ring
+        (ISSUE 4 lifted the old sharded-is-allreduce-only rejection into
+        this resolution).  ``auto`` picks the fast engine on TPU — where
+        bucketed collectives ride the ICI ring — and whenever a
+        compressed wire is requested (compression is a bucketed-engine
+        feature); the XLA:CPU test backend keeps the dense twin, which
+        is bit-identical in fp32 anyway."""
+        fast = "sharded" if self.topology == "allreduce" else "gossip"
+        if self.sync_mode == "sharded":
+            return fast
+        if self.sync_mode == "dense":
+            return "dense"
+        if self.sync_dtype in ("bfloat16", "int8"):
+            return fast
+        return fast if backend == "tpu" else "dense"
 
     def mesh_axes(self) -> dict[str, int]:
         """Parse ``mesh_shape`` into an ordered {axis: size} dict.
@@ -362,15 +379,18 @@ def build_argparser() -> argparse.ArgumentParser:
                         "recompiles")
     p.add_argument("--sync_mode", type=str, default=d.sync_mode,
                    choices=["auto", "dense", "sharded"],
-                   help="round-sync engine for the allreduce topology: "
-                        "sharded = bucketed reduce-scatter/all-gather "
-                        "(bit-identical to dense in fp32), auto = sharded "
-                        "on TPU, dense otherwise")
+                   help="round-sync engine, resolved per topology: "
+                        "sharded = the bucketed fast path (reduce-"
+                        "scatter/all-gather for allreduce, per-bucket "
+                        "ppermute gossip for ring/double_ring; both "
+                        "bit-identical to dense in fp32), auto = the "
+                        "fast path on TPU, dense otherwise")
     p.add_argument("--sync_dtype", type=str, default=d.sync_dtype,
                    choices=["float32", "bfloat16", "int8"],
-                   help="wire dtype of the sharded sync collectives "
-                        "(bfloat16 halves bytes on the wire; int8 + "
-                        "per-bucket scale quarters them)")
+                   help="wire dtype of the bucketed sync collectives, "
+                        "allreduce and gossip alike (bfloat16 halves "
+                        "bytes on the wire; int8 + per-bucket scale "
+                        "quarters them)")
     p.add_argument("--sync_compression", type=str,
                    default=d.sync_compression, choices=["none", "ef"],
                    help="ef = carry fp32 error-feedback residuals in train "

@@ -476,6 +476,13 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         train_model = build_model_for(cfg, num_classes, **base_kw, **train_kw)
     engine = LocalSGDEngine(model, mesh, cfg, train_model=train_model,
                             param_specs_fn=param_specs_fn)
+    # the engine resolution is per topology (Config.resolve_sync_mode):
+    # bucketed reduce-scatter for allreduce, bucketed ppermute gossip for
+    # ring/double_ring, legacy per-leaf dense otherwise — surfaced here
+    # (and as results["sync_engine"]) so a run artifact states which sync
+    # program produced it
+    log.info("round-sync engine: %s (topology=%s, wire=%s)",
+             engine.sync_mode, cfg.topology, cfg.sync_dtype)
     sample = trainset.images[:batch]
     state = engine.init_state(jax.random.key(cfg.seed), sample)
 
@@ -523,6 +530,7 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         "worker_specific_train_accuracies": [],
         "worker_specific_val_losses": [],
         "worker_specific_val_accuracies": [],
+        "sync_engine": engine.sync_mode,
     }
 
     def _capped(parts, caps):

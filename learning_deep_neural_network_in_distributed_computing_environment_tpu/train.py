@@ -397,10 +397,12 @@ class LocalSGDEngine:
         # error feedback needs per-worker residual state, which only the
         # weights (FedAvg) aggregation carries forward; in gradients mode
         # the aggregate is discarded after its norm, so compression error
-        # has nothing to accumulate into
+        # has nothing to accumulate into.  The residual carries
+        # per-topology: own+mean rounding for the sharded reduce-scatter,
+        # own-transmission rounding for the gossip engines.
         self.sync_ef = (cfg.sync_compression == "ef"
                         and cfg.aggregation_by == "weights"
-                        and self.sync_mode == "sharded")
+                        and self.sync_mode in ("sharded", "gossip"))
         self.sync_bucket_bytes = max(1, int(cfg.sync_bucket_mb * (1 << 20)))
         # Packed-path sync placement: on XLA:CPU the sync stays FUSED in
         # the round program — dispatching a second collective program
@@ -420,35 +422,16 @@ class LocalSGDEngine:
     def _resolve_sync_mode(self) -> str:
         """Pick the round-sync implementation from config + backend.
 
-        ``sharded`` applies to the allreduce topology only (gossip rings
-        are neighbor exchanges, not reductions).  ``auto`` chooses sharded
-        on TPU — where reduce-scatter/all-gather ride the ICI ring at
-        2(N-1)/N of the replicated buffer per worker — and whenever bf16
-        compression is requested (compression is a sharded-engine
-        feature); the XLA:CPU test backend and legacy-JAX meshes with
-        inner (TP/PP/EP) axes keep the dense twin, which is bit-identical
-        in fp32 anyway."""
-        cfg = self.cfg
-        if cfg.sync_mode == "sharded":
-            if cfg.topology != "allreduce":
-                raise ValueError(
-                    f"--sync_mode sharded applies to --topology allreduce "
-                    f"(a reduce-scatter needs a reduction); got "
-                    f"{cfg.topology!r}")
-            return "sharded"
-        if cfg.sync_mode == "dense":
-            return "dense"
-        if cfg.topology != "allreduce":
-            return "dense"
-        if cfg.sync_dtype in ("bfloat16", "int8"):
-            return "sharded"
-        # Inner (TP/PP/EP) mesh axes no longer force the dense path on
-        # legacy JAX: psum_scatter/all_to_all/all_gather over 'data' are
-        # bit-identical to the dense twin under legacy check_rep with the
-        # engine's replication re-certification — verified across
-        # model/pipe/expert inner axes in tests/test_sync.py
-        # (TestShardedSyncInnerAxes).
-        return "sharded" if jax.default_backend() == "tpu" else "dense"
+        Delegates to ``Config.resolve_sync_mode`` (per-topology: the
+        bucketed reduce-scatter engine for allreduce, the bucketed
+        ppermute gossip engine for ring/double-ring, the legacy per-leaf
+        dense path otherwise).  Inner (TP/PP/EP) mesh axes no longer
+        force the dense path on legacy JAX: psum_scatter / all_to_all /
+        all_gather / ppermute over 'data' are bit-identical to the dense
+        twin under legacy check_rep with the engine's replication
+        re-certification (tests/test_sync.py::TestShardedSyncInnerAxes).
+        """
+        return self.cfg.resolve_sync_mode(jax.default_backend())
 
     def _sync_body(self, params, grads, residual):
         """The once-per-round sync point, per worker (inside shard_map).
@@ -459,25 +442,18 @@ class LocalSGDEngine:
         their norm (reference semantics, SURVEY.md 3.2)."""
         cfg = self.cfg
         agg_grad_norm = jnp.zeros(())
+        fast = self.sync_mode in ("sharded", "gossip")
         if cfg.aggregation_by == "weights":
-            if self.sync_mode == "sharded":
-                params, residual = comms.sharded_sync(
-                    params, how=cfg.aggregation_type,
-                    local_weight=cfg.local_weight,
-                    wire_dtype=self.sync_wire_dtype,
-                    residual=residual if self.sync_ef else None,
-                    bucket_bytes=self.sync_bucket_bytes)
+            if fast:
+                params, residual = self._fast_sync(
+                    params, residual if self.sync_ef else None)
             else:
                 params = comms.aggregate(
                     params, how=cfg.aggregation_type,
                     topology=cfg.topology, local_weight=cfg.local_weight)
         else:
-            if self.sync_mode == "sharded":
-                agg, _ = comms.sharded_sync(
-                    grads, how=cfg.aggregation_type,
-                    local_weight=cfg.local_weight,
-                    wire_dtype=self.sync_wire_dtype,
-                    bucket_bytes=self.sync_bucket_bytes)
+            if fast:
+                agg, _ = self._fast_sync(grads, None)
             else:
                 agg = comms.aggregate(
                     grads, how=cfg.aggregation_type,
@@ -485,22 +461,42 @@ class LocalSGDEngine:
             agg_grad_norm = self._grad_global_norm(agg)
         return params, residual, agg_grad_norm
 
+    def _fast_sync(self, tree, residual):
+        """Run the resolved bucketed fast engine on one pytree:
+        the reduce-scatter program for ``sharded``, the ppermute gossip
+        program for ``gossip`` — same kwargs, same
+        ``(out, new_residual)`` contract."""
+        cfg = self.cfg
+        kw = dict(how=cfg.aggregation_type, local_weight=cfg.local_weight,
+                  wire_dtype=self.sync_wire_dtype, residual=residual,
+                  bucket_bytes=self.sync_bucket_bytes)
+        if self.sync_mode == "gossip":
+            return comms.gossip_sync(tree, topology=cfg.topology, **kw)
+        return comms.sharded_sync(tree, **kw)
+
     def _arm_sync_stats(self, params_stacked) -> None:
         """Reset ``last_sync_stats`` for the round being dispatched: the
         static per-round wire bytes (from the bucket plan over per-worker
-        logical shapes) + mode; ``round_wait`` adds the measured
-        ``sync_ms`` when a standalone sync program ran."""
+        logical shapes) + mode + a zero ``sync_ms``; ``round_wait``
+        overwrites ``sync_ms`` with the measured collective wall when a
+        standalone sync program ran.  The schema is identical across all
+        three topologies and every engine (zero-filled where a
+        measurement does not apply), so downstream viz/bench can key on
+        the fields unconditionally."""
         if self._sync_bytes is None:
             shapes = jax.tree_util.tree_map(
                 lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype),
                 params_stacked)
-            wire = (self.sync_wire_dtype if self.sync_mode == "sharded"
+            wire = (self.sync_wire_dtype
+                    if self.sync_mode in ("sharded", "gossip")
                     else jnp.float32)
             self._sync_bytes = comms.sync_wire_bytes(
                 shapes, self.n_workers, mode=self.sync_mode,
-                wire_dtype=wire, bucket_bytes=self.sync_bucket_bytes)
+                wire_dtype=wire, bucket_bytes=self.sync_bucket_bytes,
+                topology=self.cfg.topology)
         self.last_sync_stats = {"sync_bytes": self._sync_bytes,
-                                "sync_mode": self.sync_mode}
+                                "sync_mode": self.sync_mode,
+                                "sync_ms": 0.0}
         self._sync_probe = None
 
     # ------------------------------------------------------------------
@@ -1087,8 +1083,9 @@ class LocalSGDEngine:
                                      length=epochs_local)
 
             # --- the sync point (trainer.py:141-150) -----------------------
-            # On CPU the sync engine (dense pmean or the sharded
-            # reduce-scatter, _sync_body) runs fused HERE; under
+            # On CPU the sync engine (dense per-leaf, the sharded
+            # reduce-scatter, or the bucketed gossip — _sync_body) runs
+            # fused HERE; under
             # split_sync the round program stops pre-sync and round_start
             # dispatches the standalone donated sync program right behind
             # it (measured collective wall, two-rounds-in-flight chain).
@@ -1357,8 +1354,9 @@ class LocalSGDEngine:
         """The standalone donated sync program (streamed rounds on every
         backend; packed rounds under split_sync).  One compiled shard_map
         program runs the whole sync engine — bucketed reduce-scatter /
-        scale-on-shard / all-gather, or the dense twin — with the inputs
-        donated so the once-per-round parameter sync updates in place.
+        scale-on-shard / all-gather, bucketed ppermute gossip, or the
+        dense twin — with the inputs donated so the once-per-round
+        parameter sync updates in place.
 
         The extra ``fence`` output (weights mode) is a tiny per-worker
         scalar derived from the synced params: a never-donated completion

@@ -237,10 +237,13 @@ class TestEngineSync:
             arr = np.asarray(leaf)
             assert np.array_equal(arr, np.broadcast_to(arr[:1], arr.shape))
 
-    def test_sharded_sync_requires_allreduce_topology(self, mesh8):
-        with pytest.raises(ValueError, match="allreduce"):
-            make_engine(mesh8, small_cfg(sync_mode="sharded",
-                                         topology="ring"))
+    def test_sharded_ring_resolves_to_gossip_engine(self, mesh8):
+        # the sharded-is-allreduce-only rejection is lifted (ISSUE 4):
+        # --sync_mode sharded names the bucketed fast path, which for
+        # gossip topologies is the per-bucket ppermute engine
+        eng = make_engine(mesh8, small_cfg(sync_mode="sharded",
+                                           topology="ring"))
+        assert eng.sync_mode == "gossip"
 
     def test_auto_resolves_dense_on_cpu_sharded_for_bf16(self, mesh8):
         assert make_engine(mesh8, small_cfg()).sync_mode == "dense"
@@ -258,12 +261,13 @@ class TestConfigValidation:
         with pytest.raises(ValueError, match="bfloat16"):
             Config(sync_compression="ef")
 
-    def test_bf16_requires_allreduce_topology(self):
-        # a compressed-ring request must fail fast, not silently run the
-        # uncompressed dense gossip path (code-review finding)
-        with pytest.raises(ValueError, match="allreduce"):
-            Config(sync_dtype="bfloat16", sync_compression="ef",
-                   topology="ring")
+    def test_bf16_ring_rides_the_gossip_engine(self):
+        # a compressed-ring request used to fail fast so the flags could
+        # not be silently ignored; since ISSUE 4 the bucketed gossip
+        # engine honors them — auto must resolve onto it even on CPU
+        cfg = Config(sync_dtype="bfloat16", sync_compression="ef",
+                     topology="ring")
+        assert cfg.resolve_sync_mode("cpu") == "gossip"
 
 
 class TestDriverTelemetry:

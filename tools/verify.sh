@@ -43,6 +43,36 @@ if [ "${SKIP_BENCH:-0}" != "1" ]; then
     echo "bench smoke FAILED (rc=$brc)"
     exit "$brc"
   fi
+
+  # seconds-scale gossip-engine smoke (ISSUE 4 satellite): the --entry
+  # gossip dispatch + bucketed/compressed gossip programs run on a
+  # 2-worker virtual CPU mesh so the bench entry and engine dispatch
+  # cannot rot outside tier-1.  Asserts the fp32 bucketed path stayed
+  # bit-identical to dense and the compressed wires at exactly 1/2 and
+  # 1/4 of the fp32 bytes.
+  echo "== bench smoke: gossip sync entry (CPU, 2 workers) =="
+  GOSSIP_JSON=$(XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    JAX_PLATFORMS=cpu BENCH_BUDGET_S="${BENCH_BUDGET_S:-240}" \
+    python bench.py --entry gossip) || { echo "gossip smoke FAILED"; exit 1; }
+  echo "$GOSSIP_JSON"
+  python - "$GOSSIP_JSON" <<'EOF'
+import json, sys
+out = json.loads(sys.argv[1])
+if out.get("status") == "budget_backstop":
+    sys.exit(0)  # slow host: the backstop line is the accepted outcome
+for topo in ("ring", "double_ring"):
+    row = out[topo]
+    assert row["bitwise_bucketed_eq_dense"] is True, topo
+    assert row["bucketed"]["collectives"] < row["dense"]["collectives"], topo
+    assert row["bf16_vs_fp32_bytes"] == 0.5, topo
+    assert row["int8_vs_fp32_bytes"] == 0.25, topo
+print("gossip smoke OK")
+EOF
+  grc=$?
+  if [ "$grc" -ne 0 ]; then
+    echo "gossip smoke assertions FAILED (rc=$grc)"
+    exit "$grc"
+  fi
 fi
 
 echo "verify OK"
