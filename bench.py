@@ -716,6 +716,99 @@ def measure_gossip() -> dict:
     return out
 
 
+def measure_ckpt() -> dict:
+    """Blocking vs sharded-blocking vs async checkpoint A/B (ISSUE 5).
+
+    Over a worker-stacked ~59 MB/worker fp32 tree: (a) the legacy
+    blocking monolithic save (full gather + one msgpack serialized
+    INLINE on the caller — the pre-engine round-loop stall), (b) the
+    sharded engine with the identical write path run inline, and (c) the
+    async engine, whose caller-visible stall is only the fenced
+    device->host snapshot while serialize/checksum/fsync/manifest ride
+    the background thread.  Asserting surface: the async-saved state
+    restores BITWISE identical to the blocking save, and the sharded
+    payload bytes per process are exactly 1/process_count of the
+    full-state bytes (single-process: equal, but gather-free)."""
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu import checkpoint as ckpt_lib
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu.mesh import build_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = len(jax.devices())
+    mesh = build_mesh({"data": n})
+    rng = np.random.default_rng(0)
+    shapes = {"emb": (2048, 1024), "w1": (1024, 4096),
+              "w2": (4096, 1024), "head": (1024, 4096)}
+    sharding = NamedSharding(mesh, P("data"))
+    tree = {k: jax.device_put(np.asarray(rng.normal(size=(n, *s)),
+                                         np.float32), sharding)
+            for k, s in shapes.items()}
+    full_bytes = sum(4 * n * int(np.prod(s)) for s in shapes.values())
+    reps = 3
+    med = lambda xs: sorted(xs)[len(xs) // 2]
+    base = tempfile.mkdtemp(prefix="ckpt_bench_")
+    try:
+        d_blk = os.path.join(base, "blocking")
+        os.makedirs(d_blk)
+        blk = []
+        for r in range(1, reps + 1):
+            t0 = time.perf_counter()
+            ckpt_lib.save_checkpoint_legacy(d_blk, tree, r)
+            blk.append(time.perf_counter() - t0)
+        eng_s = ckpt_lib.CheckpointEngine(os.path.join(base, "sharded"),
+                                          keep=reps, async_write=False)
+        shd = []
+        for r in range(1, reps + 1):
+            t0 = time.perf_counter()
+            eng_s.save(tree, r)
+            shd.append(time.perf_counter() - t0)
+        eng_a = ckpt_lib.CheckpointEngine(os.path.join(base, "async"),
+                                          keep=reps, async_write=True)
+        stalls, writes = [], []
+        for r in range(1, reps + 1):
+            timing: dict = {}
+            t0 = time.perf_counter()
+            eng_a.save(tree, r, timing=timing)
+            stalls.append(time.perf_counter() - t0)
+            eng_a.wait()   # drain between reps: stall stays pure snapshot
+            writes.append(timing["ckpt_write_ms"] / 1e3)
+        eng_a.close()      # release the writer thread before restores
+        ra, _ = ckpt_lib.restore_checkpoint(
+            ckpt_lib.latest_checkpoint(os.path.join(base, "async")), tree)
+        rb, _ = ckpt_lib.restore_checkpoint(
+            os.path.join(d_blk, f"ckpt_{reps}.msgpack"), tree)
+        bitwise = all(np.array_equal(np.asarray(ra[k]), np.asarray(rb[k]))
+                      for k in shapes)
+        payload = eng_a.summary()["bytes_per_host"]
+        blocking_ms = round(med(blk) * 1e3, 3)
+        stall_ms = round(med(stalls) * 1e3, 3)
+        return {
+            "n_workers": n,
+            "process_count": jax.process_count(),
+            "state_mb": round(full_bytes / 1e6, 2),
+            "blocking_ms": blocking_ms,
+            "sharded_blocking_ms": round(med(shd) * 1e3, 3),
+            "async": {"stall_ms": stall_ms,
+                      "write_ms": round(med(writes) * 1e3, 3)},
+            "stall_vs_blocking": (round(stall_ms / blocking_ms, 4)
+                                  if blocking_ms else None),
+            "stall_reduction_x": (round(blocking_ms / stall_ms, 1)
+                                  if stall_ms else None),
+            "payload_bytes_per_host": payload,
+            "full_state_bytes": full_bytes,
+            "bytes_ratio": round(payload / full_bytes, 6),
+            "expected_bytes_ratio": round(1 / jax.process_count(), 6),
+            "bitwise_async_eq_blocking": bool(bitwise),
+        }
+    finally:
+        shutil.rmtree(base, ignore_errors=True)
+
+
 def measure_compile() -> dict:
     """Layer-scan compile-engine A/B (ISSUE 3): trace+compile wall and
     step wall for scanned vs unrolled GPT at several depths, plus the
@@ -1051,6 +1144,7 @@ SHORT = {
     "sync_collectives": "sync",
     "gossip_collectives": "gossip",
     "compile_engine": "compile",
+    "ckpt_engine": "ckpt",
 }
 
 
@@ -1081,6 +1175,8 @@ def _run_entry(key: str, entry_budget: float | None = None) -> dict:
         return measure_gossip()
     if key == "compile_engine":
         return measure_compile()
+    if key == "ckpt_engine":
+        return measure_ckpt()
     for k, name, shape, batch, steps, ncls, tok, _tmo, *extra in LADDER:
         if k == key:
             return measure_model(name, shape, batch, steps, ncls, tok,
@@ -1178,6 +1274,13 @@ def _emit_headline(details: dict, extra: dict) -> None:
                      "unr": e.get("compile_unrolled_L8_s"),
                      "scn": e.get("compile_scanned_L8_s"),
                      "same": 1 if e.get("loss_bitwise_scan_vs_unrolled")
+                     else 0}
+        elif key == "ckpt_engine":
+            d[sk] = {"blk": e.get("blocking_ms"),
+                     "sh": e.get("sharded_blocking_ms"),
+                     "st": (e.get("async") or {}).get("stall_ms"),
+                     "x": e.get("stall_reduction_x"),
+                     "same": 1 if e.get("bitwise_async_eq_blocking")
                      else 0}
         elif key == "flash_attention":
             def _flash_cell(r):
@@ -1284,7 +1387,8 @@ def main() -> None:
         # gossip-collective A/Bs, + per-L flash units run before the
         # sacrificial ViT tail
         jobs[at:at] = ([("round_gap", 150), ("sync_collectives", 120),
-                        ("gossip_collectives", 120), ("compile_engine", 150)]
+                        ("gossip_collectives", 120), ("compile_engine", 150),
+                        ("ckpt_engine", 120)]
                        + [(f"flash:L{L}", t) for L, _b, t in FLASH_POINTS])
     for key, tmo in jobs:
         rem = _remaining()

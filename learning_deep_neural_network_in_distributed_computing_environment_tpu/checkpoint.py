@@ -1,40 +1,624 @@
-"""Checkpoint / resume.
+"""Async sharded checkpoint engine (ISSUE 5).
 
 The reference has **no checkpointing** — the model lives only in memory and
-nothing but PNGs is ever written (SURVEY.md section 5).  This module is the
-documented beyond-reference improvement: the full worker-stacked
-``TrainState`` (params, BN stats, Adam moments, LR clock, RNG) plus the
-global-epoch cursor are serialized with flax msgpack, so a run can resume
-mid-experiment with every worker's local state intact.
+nothing but PNGs is ever written (SURVEY.md section 5), so this whole
+subsystem is beyond-reference.  The PR-0..4 implementation was a blocking
+collective: every host ``process_allgather``-ed the FULL worker-stacked
+``TrainState`` and serialized it to one msgpack file inline on the round
+loop — O(full-state) wire bytes and a serialize+fsync stall per save, per
+host.  This engine is the production-multihost shape instead:
+
+- **Sharded I/O**: each process writes only the addressable shards it
+  owns (``replica_id == 0`` dedups replicated leaves globally) into a
+  per-epoch directory — no gather, 1/num_hosts payload bytes per host::
+
+      ckpt_dir/
+        ckpt_<E>/
+          shard_<P>.msgpack    per-process pieces: {leaf key: [(index, array)]}
+          MANIFEST.json        commit marker, written LAST (every process)
+        ckpt_<E>.msgpack       legacy v1 single-file (restore-only back-compat)
+
+  Restore merges the pieces back into full host arrays and ``device_put``s
+  each onto its template leaf's sharding, so the save/restore meshes (and
+  the process count, on a shared filesystem) may differ freely.
+
+- **Async commit**: the round loop pays only the device->host snapshot of
+  the addressable shards (behind ``jax.block_until_ready`` — the fence
+  that keeps the donated-buffer round/sync programs from overwriting
+  in-flight state); a background writer thread serializes, checksums
+  (crc32), fsyncs, and finally publishes ``MANIFEST.json`` — a crash at
+  ANY earlier point leaves an unmanifested directory that
+  ``latest_checkpoint`` ignores and the next engine open sweeps.  At most
+  one write is in flight (the next save waits — backpressure, and the
+  snapshot pool stays bounded at one state).
+
+- **Multi-host commit protocol**: every process fsyncs its shard, then a
+  tiny ``process_allgather`` of (bytes, crc32) doubles as the
+  all-shards-durable barrier, then every process writes the identical
+  manifest (tmp + atomic rename; on a shared filesystem last-writer-wins
+  with identical content, without one each host still holds a commit
+  marker for its own shards).  Collectives stay on the MAIN thread: in
+  async mode the background job only writes the local shard and the
+  commit runs at the next ``save()``/``wait()`` call.
 """
 
 from __future__ import annotations
 
+import json
+import logging
 import os
 import re
+import shutil
+import time
+import zlib
+from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
 
 import jax
 import numpy as np
 from flax import serialization
 
-_CKPT_RE = re.compile(r"ckpt_(\d+)\.msgpack$")
+log = logging.getLogger(__name__)
 
+_LEGACY_RE = re.compile(r"ckpt_(\d+)\.msgpack$")
+_DIR_RE = re.compile(r"ckpt_(\d+)$")
+MANIFEST = "MANIFEST.json"
+FORMAT = 2
+
+# Test hook (tools/verify.sh kill-mid-write smoke): crash the process at a
+# defined point inside a save so the on-disk state is exactly what a real
+# mid-write SIGKILL leaves.  Values: "mid_shard" (partial .tmp written),
+# "before_manifest" (shards durable, manifest never published).
+_CRASH_ENV = "JAX_GRAFT_CKPT_TEST_CRASH"
+
+
+def _maybe_crash(point: str) -> None:
+    if os.environ.get(_CRASH_ENV) == point:
+        os._exit(42)
+
+
+# ----------------------------------------------------------------------
+# Snapshot: device -> host copy of the addressable shards
+# ----------------------------------------------------------------------
+
+def _piece_index(index, shape) -> list:
+    """A shard's global index as JSON/msgpack-able [[start, stop], ...];
+    unsharded dims arrive as ``slice(None)`` and normalize to [0, dim]."""
+    out = []
+    for sl, dim in zip(index, shape):
+        if sl.step not in (None, 1):
+            raise ValueError(f"strided shard index unsupported: {index}")
+        out.append([int(sl.start or 0),
+                    int(sl.stop if sl.stop is not None else dim)])
+    return out
+
+
+def snapshot_addressable(state) -> tuple[dict, dict]:
+    """Host snapshot of the shards THIS process must persist.
+
+    Returns ``(pieces, meta)``: ``pieces`` maps each leaf's key-path
+    string to a list of ``[index, ndarray]`` entries — one per addressable
+    shard with ``replica_id == 0``, so replicated leaves are written by
+    exactly one process globally and the union over processes tiles each
+    leaf exactly once; ``meta`` maps the same keys to global
+    shape/dtype/bytes.  Arrays are COPIED (never views of device buffers):
+    once this returns, the engines are free to donate/overwrite the
+    source state.  The caller fences first (``jax.block_until_ready``) so
+    no in-flight program is still writing the buffers being read.
+    """
+    pieces: dict[str, list] = {}
+    meta: dict[str, dict] = {}
+    flat, _ = jax.tree_util.tree_flatten_with_path(state)
+    for path, leaf in flat:
+        key = jax.tree_util.keystr(path)
+        if isinstance(leaf, jax.Array):
+            plist = [[_piece_index(s.index, leaf.shape),
+                      np.array(s.data, copy=True)]
+                     for s in leaf.addressable_shards if s.replica_id == 0]
+            shape, dtype = leaf.shape, leaf.dtype
+        else:  # host leaf (rare): single full piece, process 0 owns it
+            arr = np.asarray(leaf)
+            plist = ([[[[0, d] for d in arr.shape], np.array(arr)]]
+                     if jax.process_index() == 0 else [])
+            shape, dtype = arr.shape, arr.dtype
+        if plist:
+            pieces[key] = plist
+        meta[key] = {"shape": [int(d) for d in shape], "dtype": str(dtype),
+                     "bytes": int(np.prod(shape, dtype=np.int64))
+                     * np.dtype(dtype).itemsize}
+    return pieces, meta
+
+
+def _merge_pieces(key: str, plist: list, shape, dtype) -> np.ndarray:
+    """Reassemble one leaf from its (possibly cross-process) pieces.
+
+    Pieces are disjoint by construction (replica 0 of each index), so a
+    filled-element count equal to the leaf size proves full coverage —
+    a missing shard file surfaces as an explicit error here, never as
+    uninitialized memory."""
+    out = np.empty(shape, dtype)
+    filled = 0
+    for index, arr in plist:
+        sl = tuple(slice(a, b) for a, b in index)
+        out[sl] = arr
+        filled += int(arr.size)
+    if filled != out.size:
+        raise ValueError(
+            f"checkpoint leaf {key} is incomplete: pieces cover {filled} of "
+            f"{out.size} elements (missing shard file?)")
+    return out
+
+
+# ----------------------------------------------------------------------
+# The engine
+# ----------------------------------------------------------------------
+
+class CheckpointEngine:
+    """Per-run checkpoint engine: sweeps stale leftovers on open, then
+    serves off-critical-path sharded saves and every-process pruning.
+
+    ``async_write=False`` runs the identical write path inline (the A/B
+    twin for bench and tests).  ``timing`` dicts passed to ``save`` get
+    ``ckpt_snapshot_ms`` filled synchronously and ``ckpt_write_ms`` when
+    the (possibly background) write lands — the driver threads its
+    per-round ``round_timings`` entry through so stall vs hidden wall is
+    attributed per round."""
+
+    def __init__(self, ckpt_dir: str, keep: int = 3,
+                 async_write: bool = True):
+        self.dir = ckpt_dir
+        self.keep = max(1, int(keep))
+        self.async_write = bool(async_write)
+        os.makedirs(ckpt_dir, exist_ok=True)
+        self._sweep_stale()
+        self._pool = None         # writer thread, spawned at first save
+        self._pending = None      # (future, epoch, timing, leaf meta)
+        self.stats = {"saves": 0, "payload_bytes_per_save": 0,
+                      "snapshot_ms_total": 0.0, "write_ms_total": 0.0}
+
+    # -- open-time sweep (ISSUE 5 satellite) ---------------------------
+    def _sweep_stale(self) -> None:
+        """Delete unmanifested leftovers a crash mid-save left behind:
+        ``*.tmp.*`` files (legacy and in-dir) and ``ckpt_<E>/`` dirs with
+        no committed manifest.  Nothing can be in flight at open time, so
+        everything unmanifested is garbage by definition."""
+        def rm(path):
+            # every process sweeps the same shared dir at open; losing
+            # the unlink race to a peer is success, not an error
+            try:
+                os.remove(path)
+                return True
+            except FileNotFoundError:
+                return False
+
+        swept = []
+        for name in sorted(os.listdir(self.dir)):
+            path = os.path.join(self.dir, name)
+            if ".tmp." in name and os.path.isfile(path):
+                if rm(path):
+                    swept.append(name)
+            elif _DIR_RE.match(name) and os.path.isdir(path):
+                if not os.path.isfile(os.path.join(path, MANIFEST)):
+                    shutil.rmtree(path, ignore_errors=True)
+                    swept.append(name + "/")
+                else:
+                    try:
+                        inners = sorted(os.listdir(path))
+                    except FileNotFoundError:
+                        continue   # a peer pruned the dir mid-listing
+                    for inner in inners:
+                        if ".tmp." in inner and rm(os.path.join(path,
+                                                                inner)):
+                            swept.append(f"{name}/{inner}")
+        if swept:
+            log.info("swept %d stale checkpoint leftover(s) in %s: %s",
+                     len(swept), self.dir, ", ".join(swept))
+
+    # -- save ----------------------------------------------------------
+    def save(self, state, global_epoch: int, timing: dict | None = None
+             ) -> str:
+        """Snapshot ``state`` and commit it as epoch ``global_epoch``.
+
+        Blocking portion — ALL of it reported as ``ckpt_snapshot_ms``:
+        waiting out any previous in-flight write (backpressure — one
+        snapshot buffered, ever; ~0 when saves are further apart than the
+        write wall), then the fence + device->host shard copy.  Async
+        mode returns here; the serialize/checksum/fsync/manifest wall
+        rides the background thread.  EVERY process must call this (the
+        multi-host commit barrier is collective)."""
+        t0 = time.perf_counter()
+        self._finalize()
+        jax.block_until_ready(state)   # the donated-buffer snapshot fence
+        pieces, meta = snapshot_addressable(state)
+        snapshot_ms = round((time.perf_counter() - t0) * 1e3, 3)
+        payload = sum(int(a.nbytes) for pl in pieces.values()
+                      for _i, a in pl)
+        if timing is not None:
+            timing["ckpt_snapshot_ms"] = snapshot_ms
+        self.stats["saves"] += 1
+        self.stats["payload_bytes_per_save"] = payload
+        self.stats["snapshot_ms_total"] = round(
+            self.stats["snapshot_ms_total"] + snapshot_ms, 3)
+        job = lambda: self._write_shard(pieces, meta, int(global_epoch),
+                                        timing)
+        if self.async_write:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="ckpt-writer")
+            self._pending = (self._pool.submit(job), int(global_epoch),
+                             timing, meta)
+        else:
+            local = job()   # single-process commits inline in the job
+            if jax.process_count() > 1:
+                self._commit(int(global_epoch), local, meta, timing)
+        return os.path.join(self.dir, f"ckpt_{int(global_epoch)}")
+
+    def wait(self) -> None:
+        """Block until the in-flight save (if any) is fully committed.
+        Multi-host: collective (the deferred commit barrier runs here)."""
+        self._finalize()
+
+    def close(self) -> None:
+        """``wait()`` + release the writer thread.  The engine stays
+        usable (the pool respawns lazily at the next async save); without
+        a close every async engine would pin one non-daemon thread until
+        interpreter exit."""
+        self._finalize()
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    def abort(self) -> None:
+        """Exception-unwind twin of ``close()``: join and release the
+        writer WITHOUT the (multi-host: collective) deferred commit — a
+        collective entered during one process's unwind is one its peers
+        may never match, turning a loud crash into a job-wide hang.  The
+        epoch stays unmanifested (swept at the next engine open); a
+        writer failure is logged, not raised, so the original exception
+        keeps propagating."""
+        pending, self._pending = self._pending, None
+        if pending is not None:
+            try:
+                pending[0].result()
+            except Exception:  # noqa: BLE001 — unwind must not be masked
+                log.exception("checkpoint writer failed during abort")
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    def _finalize(self) -> None:
+        if self._pending is None:
+            return
+        fut, epoch, timing, meta = self._pending
+        self._pending = None
+        local = fut.result()   # re-raises background write failures loudly
+        if jax.process_count() > 1:
+            # the commit is collective; it was deferred off the writer
+            # thread so its allgather runs HERE, on the main thread, in
+            # the same program order on every process
+            self._commit(epoch, local, meta, timing)
+
+    def _write_shard(self, pieces, meta, epoch: int, timing) -> dict:
+        """Serialize + checksum + fsync this process's shard file.
+        Returns {"bytes", "crc32", "payload_bytes"}.  Single-process runs
+        the commit inline (no barrier needed)."""
+        t0 = time.perf_counter()
+        p = jax.process_index()
+        d = os.path.join(self.dir, f"ckpt_{epoch}")
+        os.makedirs(d, exist_ok=True)
+        raw = serialization.msgpack_serialize(
+            {"format": FORMAT, "process": p, "leaves": pieces})
+        path = os.path.join(d, f"shard_{p}.msgpack")
+        tmp = f"{path}.tmp.{p}"
+        with open(tmp, "wb") as f:
+            f.write(raw)
+            _maybe_crash("mid_shard")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        local = {"bytes": len(raw), "crc32": zlib.crc32(raw),
+                 "payload_bytes": sum(int(a.nbytes)
+                                      for pl in pieces.values()
+                                      for _i, a in pl)}
+        _maybe_crash("before_manifest")
+        if jax.process_count() == 1:
+            self._commit(epoch, local, meta, timing, t_start=t0)
+        else:
+            # multi-host: the commit wall lands separately (deferred to
+            # the main thread, _commit with t_start=None adds it); record
+            # the serialize+fsync wall here so write_ms_total covers the
+            # whole background cost on every backend
+            write_ms = round((time.perf_counter() - t0) * 1e3, 3)
+            self.stats["write_ms_total"] = round(
+                self.stats["write_ms_total"] + write_ms, 3)
+            if timing is not None:
+                timing["ckpt_write_ms"] = write_ms
+        return local
+
+    def _commit(self, epoch: int, local: dict, meta, timing,
+                t_start: float | None = None) -> None:
+        """Publish MANIFEST.json (the atomic commit marker), then prune.
+
+        Multi-host: allgather the per-shard (bytes, crc) — which doubles
+        as the all-shards-durable barrier — so every process writes the
+        identical manifest.  A crash anywhere before the ``os.replace``
+        leaves the epoch unmanifested: invisible to ``latest_checkpoint``
+        and swept at the next engine open."""
+        t0 = t_start if t_start is not None else time.perf_counter()
+        pc = jax.process_count()
+        if pc > 1:
+            from jax.experimental import multihost_utils
+            gathered = multihost_utils.process_allgather(
+                np.array([local["bytes"], local["crc32"],
+                          local["payload_bytes"]], np.int64))
+            shards = {f"shard_{q}.msgpack":
+                      {"bytes": int(gathered[q][0]),
+                       "crc32": int(gathered[q][1]),
+                       "payload_bytes": int(gathered[q][2])}
+                      for q in range(pc)}
+        else:
+            shards = {"shard_0.msgpack": local}
+        d = os.path.join(self.dir, f"ckpt_{epoch}")
+        manifest = {"format": FORMAT, "global_epoch": int(epoch),
+                    "process_count": pc, "shards": shards, "leaves": meta}
+        path = os.path.join(d, MANIFEST)
+        tmp = f"{path}.tmp.{jax.process_index()}"
+        with open(tmp, "w") as f:
+            json.dump(manifest, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)   # <- the commit point
+        self._prune()
+        write_ms = round((time.perf_counter() - t0) * 1e3, 3)
+        self.stats["write_ms_total"] = round(
+            self.stats["write_ms_total"] + write_ms, 3)
+        if timing is not None:
+            # += : multi-host async splits the wall between the writer
+            # thread (shard) and this deferred main-thread commit
+            timing["ckpt_write_ms"] = round(
+                timing.get("ckpt_write_ms", 0.0) + write_ms, 3)
+
+    # -- prune (ISSUE 5 satellite) -------------------------------------
+    def _prune(self) -> None:
+        """EVERY process prunes to the ``keep`` newest COMMITTED epochs.
+
+        The old implementation pruned on process 0 only, so hosts on
+        non-shared filesystems accumulated every epoch forever.  Each
+        process now removes what it can see; concurrent removal on a
+        shared filesystem is race-tolerant (``rmtree(ignore_errors)``,
+        ENOENT swallowed).  Uncommitted dirs are never touched here (an
+        in-flight save must survive); the open-time sweep owns those."""
+        # age out MANIFESTED epochs (the commit marker), not merely
+        # locally-restorable ones: a non-shared-fs host sees only its own
+        # shards, so keying on restorability would never prune there —
+        # the exact leak this fixes — and a corrupt-but-manifested epoch
+        # must age out too instead of lingering forever
+        committed = sorted(set(_manifested_epochs(self.dir))
+                           | set(_legacy_epochs(self.dir)))
+        for old in committed[:-self.keep]:
+            shutil.rmtree(os.path.join(self.dir, f"ckpt_{old}"),
+                          ignore_errors=True)
+            try:
+                os.remove(os.path.join(self.dir, f"ckpt_{old}.msgpack"))
+            except FileNotFoundError:
+                pass
+
+    # -- queries -------------------------------------------------------
+    def latest_checkpoint(self) -> Optional[str]:
+        return latest_checkpoint(self.dir)
+
+    def summary(self) -> dict:
+        """Run-level telemetry for ``results["checkpoint"]``."""
+        return {"enabled": True, "async": self.async_write,
+                "layout": "sharded", "keep": self.keep,
+                "saves": self.stats["saves"],
+                "bytes_per_host": self.stats["payload_bytes_per_save"],
+                "stall_ms_total": self.stats["snapshot_ms_total"],
+                "write_ms_total": self.stats["write_ms_total"]}
+
+
+# ----------------------------------------------------------------------
+# Listing / validation
+# ----------------------------------------------------------------------
+
+def _legacy_epochs(ckpt_dir: str) -> list[int]:
+    if not os.path.isdir(ckpt_dir):
+        return []
+    return sorted(int(m.group(1)) for name in os.listdir(ckpt_dir)
+                  if (m := _LEGACY_RE.match(name)))
+
+
+def _read_manifest(epoch_dir: str) -> Optional[dict]:
+    try:
+        with open(os.path.join(epoch_dir, MANIFEST)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _valid_sharded(epoch_dir: str) -> bool:
+    """A sharded epoch is restorable iff its manifest parses and EVERY
+    manifested shard file is present at its manifested size (cheap
+    truncation/loss check; full crc verification happens at restore).
+    Restore merges the pieces into FULL host arrays, so a missing shard
+    is exactly as unrestorable as a truncated one — both must drop the
+    epoch so ``latest_checkpoint`` falls back to an intact one.  (This
+    also means multi-host restore needs a shared filesystem, the layout's
+    documented requirement.)"""
+    manifest = _read_manifest(epoch_dir)
+    if not manifest or "shards" not in manifest:
+        return False
+    for fname, info in manifest["shards"].items():
+        path = os.path.join(epoch_dir, fname)
+        if (not os.path.isfile(path)
+                or os.path.getsize(path) != int(info["bytes"])):
+            return False
+    return True
+
+
+def _sharded_epochs(ckpt_dir: str) -> list[int]:
+    if not os.path.isdir(ckpt_dir):
+        return []
+    out = []
+    for name in os.listdir(ckpt_dir):
+        m = _DIR_RE.match(name)
+        if m and _valid_sharded(os.path.join(ckpt_dir, name)):
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def _manifested_epochs(ckpt_dir: str) -> list[int]:
+    """Epochs whose commit marker exists locally, restorable or not —
+    the prune population (see ``CheckpointEngine._prune``)."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    return sorted(
+        int(m.group(1)) for name in os.listdir(ckpt_dir)
+        if (m := _DIR_RE.match(name))
+        and os.path.isfile(os.path.join(ckpt_dir, name, MANIFEST)))
+
+
+def committed_epochs(ckpt_dir: str) -> list[int]:
+    """Epochs with a restorable checkpoint (committed sharded dirs plus
+    legacy single files), ascending.  A truncated shard or missing
+    manifest drops its epoch from this list — ``latest_checkpoint`` then
+    falls back to the newest epoch that IS intact."""
+    return sorted(set(_sharded_epochs(ckpt_dir))
+                  | set(_legacy_epochs(ckpt_dir)))
+
+
+def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
+    """Path of the newest COMMITTED checkpoint, agreed across hosts.
+
+    Multi-host: every process must call this together.  Restore re-shards
+    with ``jax.device_put`` onto cross-process shardings — a collective
+    all hosts must enter — so the resume decision itself has to be
+    identical everywhere.  Process 0's newest committed epoch is
+    broadcast; hosts that cannot restore it (e.g. lost local disk) fail
+    loudly instead of hanging."""
+    epochs = committed_epochs(ckpt_dir)
+    local = max(epochs) if epochs else -1
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        agreed = int(multihost_utils.broadcast_one_to_all(np.int32(local)))
+        if agreed >= 0 and agreed not in epochs:
+            raise FileNotFoundError(
+                f"process {jax.process_index()} is missing checkpoint epoch "
+                f"{agreed} present on process 0 ({ckpt_dir}); cannot resume "
+                "consistently")
+        local = agreed
+    if local < 0:
+        return None
+    d = os.path.join(ckpt_dir, f"ckpt_{local}")
+    if _valid_sharded(d):
+        return d
+    return os.path.join(ckpt_dir, f"ckpt_{local}.msgpack")
+
+
+# ----------------------------------------------------------------------
+# Restore
+# ----------------------------------------------------------------------
+
+def host_tree(path: str) -> tuple[dict[str, np.ndarray], int]:
+    """Template-free inspection load of a SHARDED checkpoint: merge every
+    locally-visible shard into ``{leaf key: full host ndarray}`` and
+    return it with the committed epoch.  Verifies crc32 per shard file."""
+    manifest = _read_manifest(path)
+    if not manifest:
+        raise FileNotFoundError(f"no committed manifest under {path}")
+    pieces: dict[str, list] = {}
+    for fname, info in manifest["shards"].items():
+        fp = os.path.join(path, fname)
+        if not os.path.isfile(fp):
+            continue
+        with open(fp, "rb") as f:
+            raw = f.read()
+        if (len(raw) != int(info["bytes"])
+                or zlib.crc32(raw) != int(info["crc32"])):
+            raise ValueError(
+                f"checkpoint shard {fp} is corrupt (size/crc mismatch vs "
+                "manifest)")
+        payload = serialization.msgpack_restore(raw)
+        for key, plist in payload["leaves"].items():
+            pieces.setdefault(key, []).extend(plist)
+    out = {}
+    for key, info in manifest["leaves"].items():
+        if key not in pieces:
+            raise ValueError(f"checkpoint leaf {key} has no pieces in any "
+                             f"visible shard under {path}")
+        plist = pieces[key]
+        out[key] = _merge_pieces(key, plist, tuple(info["shape"]),
+                                 plist[0][1].dtype)
+    return out, int(manifest["global_epoch"])
+
+
+def restore_checkpoint(path: str, state_template):
+    """Restore ``(state, global_epoch)`` from a checkpoint path.
+
+    ``path`` is a committed sharded directory (format 2) or a legacy
+    single msgpack file (format 1 — back-compat shim).  The template
+    provides the pytree structure/shapes AND the target shardings: each
+    restored host array is ``device_put`` onto its template leaf's
+    sharding, so resuming on a different mesh/host-count re-shards
+    cleanly instead of leaving host numpy in the tree."""
+    if os.path.isdir(path):
+        merged, epoch = host_tree(path)
+        flat, treedef = jax.tree_util.tree_flatten_with_path(state_template)
+        leaves = []
+        for kpath, tmpl in flat:
+            key = jax.tree_util.keystr(kpath)
+            if key not in merged:
+                raise ValueError(
+                    f"checkpoint {path} has no leaf {key} required by the "
+                    "restore template (engine config mismatch?)")
+            val = merged[key]
+            if tuple(val.shape) != tuple(np.shape(tmpl)):
+                raise ValueError(
+                    f"checkpoint leaf {key} shape {val.shape} does not "
+                    f"match template {np.shape(tmpl)}")
+            tdt = getattr(tmpl, "dtype", None)
+            if tdt is not None and np.dtype(tdt) != np.dtype(val.dtype):
+                raise ValueError(
+                    f"checkpoint leaf {key} dtype {val.dtype} does not "
+                    f"match template {tdt} (saved with a different "
+                    "--dtype/--compute_dtype config?)")
+            leaves.append(_reshard_leaf(tmpl, val))
+        return jax.tree_util.tree_unflatten(treedef, leaves), epoch
+    # ---- legacy v1 single file ---------------------------------------
+    with open(path, "rb") as f:
+        data = f.read()
+    payload = serialization.from_bytes(
+        {"state": state_template, "global_epoch": 0}, data)
+    state = jax.tree.map(_reshard_leaf, state_template, payload["state"])
+    return state, int(payload["global_epoch"])
+
+
+def _reshard_leaf(tmpl, val):
+    if isinstance(tmpl, jax.Array) and hasattr(tmpl, "sharding"):
+        return jax.device_put(val, tmpl.sharding)
+    return val
+
+
+# ----------------------------------------------------------------------
+# Back-compat module API (blocking wrappers over the engine)
+# ----------------------------------------------------------------------
 
 def save_checkpoint(ckpt_dir: str, state, global_epoch: int,
                     keep: int = 3) -> str:
-    """Write ``ckpt_<global_epoch>.msgpack``; prune to the newest ``keep``.
+    """Blocking sharded save (module-level convenience; the driver holds a
+    long-lived ``CheckpointEngine`` instead).  EVERY process must call
+    this — the commit barrier is collective.  Note the transient engine's
+    open-time sweep: do not mix with a concurrently-writing async engine
+    on the same directory."""
+    eng = CheckpointEngine(ckpt_dir, keep=keep, async_write=False)
+    return eng.save(state, global_epoch)
 
-    EVERY process must call this (the multi-host gather below is a
-    collective all hosts must enter).  The gather lands the full state on
-    every host, so every process writes its own copy — per-process tmp
-    name + atomic rename makes this safe on a shared filesystem (identical
-    content, last rename wins) and self-sufficient without one (each host
-    can restore from local disk).
-    """
+
+def save_checkpoint_legacy(ckpt_dir: str, state, global_epoch: int) -> str:
+    """The pre-engine blocking save (format 1): gather the FULL state to
+    every host, serialize one msgpack inline.  Kept as the bench A/B twin
+    and to manufacture legacy checkpoints for the back-compat tests."""
     if jax.process_count() > 1:
-        # sharded leaves span non-addressable devices; gather them to every
-        # host (tiled => concatenated along the worker axis) before saving
         from jax.experimental import multihost_utils
         host_state = multihost_utils.process_allgather(state, tiled=True)
     else:
@@ -45,69 +629,5 @@ def save_checkpoint(ckpt_dir: str, state, global_epoch: int,
     tmp = f"{path}.tmp.{jax.process_index()}"
     with open(tmp, "wb") as f:
         f.write(serialization.to_bytes(payload))
-    os.replace(tmp, path)  # atomic publish
-    if jax.process_index() == 0:
-        for old in _list(ckpt_dir)[:-keep]:
-            try:
-                os.remove(os.path.join(ckpt_dir, f"ckpt_{old}.msgpack"))
-            except FileNotFoundError:
-                pass  # another host pruned first (shared filesystem)
+    os.replace(tmp, path)
     return path
-
-
-def _list(ckpt_dir: str) -> list[int]:
-    if not os.path.isdir(ckpt_dir):
-        return []
-    out = []
-    for name in os.listdir(ckpt_dir):
-        m = _CKPT_RE.match(name)
-        if m:
-            out.append(int(m.group(1)))
-    return sorted(out)
-
-
-def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
-    """Newest checkpoint path, agreed across hosts.
-
-    Multi-host: every process must call this together.  Restore re-shards
-    with ``jax.device_put`` onto cross-process shardings — a collective all
-    hosts must enter — so the resume decision itself has to be identical
-    everywhere.  Process 0's view of the newest epoch is broadcast; hosts
-    that disagree (e.g. lost local disk) fail loudly instead of hanging.
-    """
-    epochs = _list(ckpt_dir)
-    local = max(epochs) if epochs else -1
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        agreed = int(multihost_utils.broadcast_one_to_all(
-            np.int32(local)))
-        if agreed >= 0 and agreed not in epochs:
-            raise FileNotFoundError(
-                f"process {jax.process_index()} is missing checkpoint epoch "
-                f"{agreed} present on process 0 ({ckpt_dir}); cannot resume "
-                "consistently")
-        local = agreed
-    if local < 0:
-        return None
-    return os.path.join(ckpt_dir, f"ckpt_{local}.msgpack")
-
-
-def restore_checkpoint(path: str, state_template):
-    """Restore (state, global_epoch) from a checkpoint file.  The template
-    provides the pytree structure/shapes (e.g. a freshly initialized
-    TrainState) AND the target shardings: each restored host array is
-    ``device_put`` back onto its template leaf's sharding, so resuming on a
-    (possibly multi-host) mesh re-shards correctly instead of leaving host
-    numpy in the tree."""
-    with open(path, "rb") as f:
-        data = f.read()
-    payload = serialization.from_bytes(
-        {"state": state_template, "global_epoch": 0}, data)
-
-    def _reshard(tmpl, val):
-        if isinstance(tmpl, jax.Array) and hasattr(tmpl, "sharding"):
-            return jax.device_put(val, tmpl.sharding)
-        return val
-
-    state = jax.tree.map(_reshard, state_template, payload["state"])
-    return state, int(payload["global_epoch"])

@@ -69,6 +69,14 @@ class Config:
     out_dir: str = "Graphs"       # plot output dir (ref: Graphs/*.png)
     checkpoint_dir: str = ""      # empty => checkpointing off
     checkpoint_every: int = 0     # global epochs between checkpoints
+    # Async checkpoint engine (ISSUE 5): True = the round loop pays only
+    # the device->host snapshot and a background thread serializes,
+    # checksums, fsyncs, and manifest-commits the per-process shards;
+    # False = the identical sharded write path runs inline (debugging /
+    # A-B benches).  Either way the save is gather-free and atomic (an
+    # epoch without its MANIFEST.json is never restored from).
+    ckpt_async: bool = True
+    ckpt_keep: int = 3            # committed checkpoints retained by prune
     resume: bool = False
     profile_dir: str = ""         # empty => no jax.profiler traces
     log_level: str = "info"
@@ -199,6 +207,19 @@ class Config:
             raise ValueError(
                 "--sync_compression ef compensates compressed-wire "
                 "rounding; it requires --sync_dtype bfloat16 or int8")
+        if self.checkpoint_every < 0:
+            raise ValueError(
+                f"checkpoint_every must be >= 0, got {self.checkpoint_every}")
+        if self.checkpoint_every > 0 and not self.checkpoint_dir:
+            raise ValueError(
+                "--checkpoint_every needs --checkpoint_dir (nowhere to "
+                "write the shards)")
+        if self.resume and not self.checkpoint_dir:
+            raise ValueError(
+                "--resume needs --checkpoint_dir (nowhere to restore from)")
+        if self.ckpt_keep < 1:
+            raise ValueError(
+                f"ckpt_keep must be >= 1, got {self.ckpt_keep}")
         if self.sync_bucket_mb <= 0:
             raise ValueError(
                 f"sync_bucket_mb must be positive, got {self.sync_bucket_mb}")
@@ -310,6 +331,15 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--out_dir", type=str, default=d.out_dir)
     p.add_argument("--checkpoint_dir", type=str, default=d.checkpoint_dir)
     p.add_argument("--checkpoint_every", type=int, default=d.checkpoint_every)
+    p.add_argument("--ckpt_async", choices=["on", "off"],
+                   default="on" if d.ckpt_async else "off",
+                   help="off-critical-path checkpointing: the round loop "
+                        "pays only the device->host snapshot; a background "
+                        "thread writes + manifest-commits the per-process "
+                        "shards (off = identical write path, inline)")
+    p.add_argument("--ckpt_keep", type=int, default=d.ckpt_keep,
+                   help="committed checkpoints retained by the "
+                        "every-process prune")
     p.add_argument("--resume", action="store_true")
     p.add_argument("--profile_dir", type=str, default=d.profile_dir)
     p.add_argument("--limit_train_samples", type=int, default=d.limit_train_samples)
@@ -421,6 +451,7 @@ def config_from_args(argv: list[str] | None = None) -> Config:
     kw = {k: v for k, v in vars(args).items() if k in field_names}
     kw["augment"] = not args.no_augment
     kw["overlap_rounds"] = not args.no_overlap_rounds
+    kw["ckpt_async"] = args.ckpt_async == "on"
     cfg = Config(**kw)
     if cfg.compile_cache_dir:
         # arm the persistent compile cache up front so even the probe /

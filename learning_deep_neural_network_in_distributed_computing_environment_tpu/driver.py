@@ -21,6 +21,7 @@ Returns the reference's twelve metric structures under their original names
 from __future__ import annotations
 
 import logging
+import sys
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Sequence
@@ -486,10 +487,18 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     sample = trainset.images[:batch]
     state = engine.init_state(jax.random.key(cfg.seed), sample)
 
-    # --- resume (beyond-reference; no-op when checkpointing is off) ------
+    # --- checkpoint engine + resume (beyond-reference; off when no dir) --
+    # Opening the engine sweeps stale mid-write leftovers (.tmp files,
+    # unmanifested ckpt_<E>/ dirs) BEFORE the resume decision, so a crash
+    # during the previous run's save can never be restored from.
+    ckpt_engine = None
+    if cfg.checkpoint_dir:
+        ckpt_engine = ckpt_lib.CheckpointEngine(
+            cfg.checkpoint_dir, keep=cfg.ckpt_keep,
+            async_write=cfg.ckpt_async)
     start_epoch = 0
-    if cfg.checkpoint_dir and cfg.resume:
-        latest = ckpt_lib.latest_checkpoint(cfg.checkpoint_dir)
+    if ckpt_engine is not None and cfg.resume:
+        latest = ckpt_engine.latest_checkpoint()
         if latest:
             state, start_epoch = ckpt_lib.restore_checkpoint(latest, state)
             log.info("resumed from %s at global epoch %d", latest, start_epoch)
@@ -607,10 +616,13 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             pass
     # Multi-host: the metric fetch is a COLLECTIVE (process_allgather);
     # running it on a worker thread would interleave with the main
-    # thread's collectives (walls exchange, checkpoint gather, the next
-    # round itself) in different per-process orders — a rendezvous
-    # hazard.  Overlap therefore applies single-process only; multi-host
-    # keeps the serial data flow (identical results either way).
+    # thread's collectives (walls exchange, the checkpoint commit
+    # barrier, the next round itself) in different per-process orders —
+    # a rendezvous hazard.  (The checkpoint engine keeps its own
+    # collective on the main thread for the same reason: the background
+    # writer only does local file I/O.)  Overlap therefore applies
+    # single-process only; multi-host keeps the serial data flow
+    # (identical results either way).
     overlap = cfg.overlap_rounds and jax.process_count() == 1
     streaming = cfg.stream_chunk_steps > 0
     # ROADMAP overlap follow-on (a): the pre-dispatch state barrier exists
@@ -801,7 +813,12 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                 pending.pop(0).result()
             results["step_caps"].append(list(prep["caps"]))
             results["shard_sizes"].append(list(prep["sizes"]))
-            timing: dict[str, Any] = {}
+            # zero-filled checkpoint walls (sync_ms convention: the schema
+            # is identical every round; save rounds overwrite).  The
+            # background writer fills ckpt_write_ms when its write lands —
+            # always before results return (ckpt_engine.wait in finally).
+            timing: dict[str, Any] = {"ckpt_snapshot_ms": 0.0,
+                                      "ckpt_write_ms": 0.0}
             results["round_timings"].append(timing)
             t_disp = time.perf_counter()
             if t_ready is not None:
@@ -868,18 +885,50 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                     timing["prep_ms"] = round(
                         (time.perf_counter() - t0) * 1e3, 3)
 
+            if ckpt_engine is not None and jax.process_count() > 1:
+                # bound the multi-host deferred-commit window to ONE
+                # round: the previous save's shard write overlapped this
+                # round's compute; publish its manifest NOW instead of at
+                # the next save, which could leave a fully-durable epoch
+                # unmanifested (= unrestorable) for checkpoint_every
+                # rounds.  Every process reaches this point every round
+                # AFTER round_wait and the metric fetch, so the commit's
+                # allgather matches across processes and stays strictly
+                # serialized with the loop's other collectives.  No-op
+                # when nothing is pending; single-process commits inside
+                # the writer job and never defers.
+                ckpt_engine.wait()
             if ckpt_due:
-                # every process enters (the save gathers collectively);
-                # only process 0 writes the file.  The state is ready and
-                # the next round is NOT yet dispatched, so the save reads
-                # the buffers before donation can invalidate them.
-                ckpt_lib.save_checkpoint(cfg.checkpoint_dir, state,
-                                         global_epoch + 1)
+                # every process enters (the multi-host manifest commit is
+                # collective) and writes ONLY its addressable shards — no
+                # gather.  Checkpoint rounds never defer (ckpt_due excludes
+                # them from the deep pipeline above), so the state is
+                # materialized and the next round is NOT yet dispatched;
+                # the engine fence + host snapshot then read the buffers
+                # before donation can invalidate them, and the round loop
+                # resumes while the background thread serializes + commits.
+                ckpt_engine.save(engine.checkpoint_fence(state),
+                                 global_epoch + 1, timing=timing)
     finally:
-        if executor is not None:
-            for fut in pending:
-                fut.result()   # propagate worker-thread failures loudly
-            executor.shutdown(wait=True)
+        try:
+            if executor is not None:
+                for fut in pending:
+                    fut.result()   # propagate worker-thread failures loudly
+                executor.shutdown(wait=True)
+        finally:
+            # runs even when a metric worker raised above.  Success path:
+            # close() drains the in-flight write (failure re-raised
+            # loudly; multi-host: the deferred commit barrier runs here,
+            # on the main thread, on every process), records the final
+            # ckpt_write_ms, and releases the writer thread.  Exception
+            # path: abort() — same drain WITHOUT the commit collective,
+            # which peers unwinding elsewhere might never match (a hang
+            # would eat the real traceback).
+            if ckpt_engine is not None:
+                if sys.exc_info()[0] is None:
+                    ckpt_engine.close()
+                else:
+                    ckpt_engine.abort()
 
     if pbar is not None:
         pbar.close()
@@ -898,6 +947,12 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
              "on" if results["compile_cache"]["enabled"] else "off",
              results["compile_cache"]["hits"],
              results["compile_cache"]["misses"])
+
+    # checkpoint-engine telemetry: total round-loop stall (the snapshot
+    # walls) vs the hidden background write wall, bytes per host per save
+    results["checkpoint"] = (ckpt_engine.summary()
+                             if ckpt_engine is not None
+                             else {"enabled": False})
 
     results["state"] = state
     results["mesh"] = mesh

@@ -1250,6 +1250,22 @@ class LocalSGDEngine:
                     (time.perf_counter() - t0) * 1e3, 3)
         return jax.block_until_ready(new_state)
 
+    def checkpoint_fence(self, state: TrainState) -> TrainState:
+        """Barrier a checkpoint snapshot needs before reading ``state``.
+
+        Every engine program DONATES its state input (the round program,
+        the standalone sync program, the chunk programs), so a snapshot
+        taken while any of them is still in flight would copy bytes the
+        next dispatch is free to overwrite.  Blocking here pins the
+        invariant to the save path itself instead of relying on which
+        driver pipeline mode (serial / overlapped / deep) happened to
+        have barriered already; on an already-materialized state it
+        costs nothing.  The checkpoint engine's device->host shard copy
+        (``checkpoint.snapshot_addressable``) runs right behind this
+        fence — together they are the host-staging snapshot pool the
+        ROADMAP's offloaded-remat item waits on."""
+        return jax.block_until_ready(state)
+
     def round_done_marker(self, handle):
         """A small, never-donated device array that materializes when the
         round's device work — including any standalone sync program — has

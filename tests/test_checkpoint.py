@@ -1,43 +1,220 @@
-"""Checkpoint/resume round-trip (beyond-reference feature, SURVEY.md 5)."""
+"""Async sharded checkpoint engine (ISSUE 5; beyond-reference, SURVEY.md 5).
+
+Covers the engine contract end to end: sharded save/restore round-trip,
+async-vs-blocking bitwise identity, atomic manifest commit (crash debris
+is never restorable and falls back to the previous committed epoch),
+open-time sweep of mid-write leftovers, every-process prune, resharding
+across meshes, the legacy single-file back-compat shim, and the driver's
+resume + round-timing telemetry integration.
+"""
+
+import os
 
 import numpy as np
 
 import jax
+import pytest
 
 from learning_deep_neural_network_in_distributed_computing_environment_tpu import checkpoint as C
 from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
 from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.mesh import build_mesh
 from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import get_model
 from learning_deep_neural_network_in_distributed_computing_environment_tpu.train import LocalSGDEngine
 
 
-def test_save_restore_roundtrip(mesh8, tmp_path):
+def _mlp_state(mesh, seed=0):
     cfg = Config(model="mlp", epochs_local=1, batch_size=8,
                  compute_dtype="float32", augment=False)
     engine = LocalSGDEngine(get_model("mlp", num_classes=10, hidden=8),
-                            mesh8, cfg)
-    x = np.zeros((8, 1, 8, 28, 28, 1), np.float32)
-    state = engine.init_state(jax.random.key(0), x[0, 0])
+                            mesh, cfg)
+    x = np.zeros((8, 28, 28, 1), np.float32)
+    return engine, engine.init_state(jax.random.key(seed), x)
+
+
+def _assert_trees_equal(a, b):
+    for la, lb in zip(jax.tree_util.tree_leaves(a),
+                      jax.tree_util.tree_leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_save_restore_roundtrip(mesh8, tmp_path):
+    engine, state = _mlp_state(mesh8, seed=0)
     path = C.save_checkpoint(str(tmp_path), state, global_epoch=3)
+    assert os.path.isdir(path)                       # sharded layout
+    assert os.path.isfile(os.path.join(path, C.MANIFEST))
     assert C.latest_checkpoint(str(tmp_path)) == path
-    template = engine.init_state(jax.random.key(1), x[0, 0])
+    _, template = _mlp_state(mesh8, seed=1)
     restored, epoch = C.restore_checkpoint(path, template)
     assert epoch == 3
-    for a, b in zip(jax.tree_util.tree_leaves(state.params),
-                    jax.tree_util.tree_leaves(restored.params)):
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    _assert_trees_equal(state.params, restored.params)
+    # restored leaves land on the TEMPLATE's shardings
+    for t, r in zip(jax.tree_util.tree_leaves(template),
+                    jax.tree_util.tree_leaves(restored)):
+        assert r.sharding == t.sharding
 
 
-def test_prune_keeps_newest(mesh8, tmp_path):
+def test_async_save_bitwise_equals_blocking(mesh8, tmp_path):
+    """The async engine's committed bytes are the blocking engine's —
+    the background thread changes WHEN the write happens, never what."""
+    engine, state = _mlp_state(mesh8, seed=2)
+    da, db = str(tmp_path / "async"), str(tmp_path / "blocking")
+    ea = C.CheckpointEngine(da, async_write=True)
+    eb = C.CheckpointEngine(db, async_write=False)
+    timing = {}
+    ea.save(state, 5, timing=timing)
+    eb.save(state, 5)
+    ea.wait()
+    assert timing["ckpt_snapshot_ms"] > 0 and timing["ckpt_write_ms"] > 0
+    _, template = _mlp_state(mesh8, seed=3)
+    ra, _ = C.restore_checkpoint(C.latest_checkpoint(da), template)
+    rb, _ = C.restore_checkpoint(C.latest_checkpoint(db), template)
+    _assert_trees_equal(ra, rb)
+    _assert_trees_equal(ra, state)
+    # identical payloads -> identical shard bytes on disk
+    raw = lambda d: open(os.path.join(d, "ckpt_5", "shard_0.msgpack"),
+                         "rb").read()
+    assert raw(da) == raw(db)
+    assert ea.summary()["bytes_per_host"] == eb.summary()["bytes_per_host"]
+
+
+def test_prune_keeps_newest_committed(mesh8, tmp_path):
+    engine, state = _mlp_state(mesh8, seed=0)
+    eng = C.CheckpointEngine(str(tmp_path), keep=2, async_write=False)
+    for e in range(1, 6):
+        eng.save(state, e)
+    assert C.committed_epochs(str(tmp_path)) == [4, 5]
+    # pruned epochs are gone from disk, not just from the listing
+    assert sorted(n for n in os.listdir(tmp_path)
+                  if n.startswith("ckpt_")) == ["ckpt_4", "ckpt_5"]
+
+
+def test_crash_fallback_to_previous_committed(mesh8, tmp_path):
+    """Mid-write debris (no manifest / truncated shard) must make
+    ``latest_checkpoint`` fall back to the newest INTACT epoch."""
+    engine, state = _mlp_state(mesh8, seed=0)
+    eng = C.CheckpointEngine(str(tmp_path), async_write=False)
+    eng.save(state, 1)
+    eng.save(state, 2)
+    # crash between shard write and manifest commit: dir, no MANIFEST
+    os.makedirs(tmp_path / "ckpt_3")
+    (tmp_path / "ckpt_3" / "shard_0.msgpack").write_bytes(b"partial")
+    assert C.committed_epochs(str(tmp_path)) == [1, 2]
+    # post-commit truncation of epoch 2's shard: size mismatch vs manifest
+    sh = tmp_path / "ckpt_2" / "shard_0.msgpack"
+    sh.write_bytes(sh.read_bytes()[:64])
+    assert C.committed_epochs(str(tmp_path)) == [1]
+    latest = C.latest_checkpoint(str(tmp_path))
+    assert latest.endswith("ckpt_1")
+    _, template = _mlp_state(mesh8, seed=1)
+    restored, epoch = C.restore_checkpoint(latest, template)
+    assert epoch == 1
+    _assert_trees_equal(restored, state)
+
+
+def test_missing_shard_falls_back(mesh8, tmp_path):
+    """A manifested epoch with a LOST (not just truncated) shard file is
+    exactly as unrestorable — it must drop out of the committed listing
+    so latest falls back, instead of surfacing as a restore crash."""
+    engine, state = _mlp_state(mesh8, seed=0)
+    eng = C.CheckpointEngine(str(tmp_path), async_write=False)
+    eng.save(state, 1)
+    eng.save(state, 2)
+    os.remove(tmp_path / "ckpt_2" / "shard_0.msgpack")
+    assert C.committed_epochs(str(tmp_path)) == [1]
+    assert C.latest_checkpoint(str(tmp_path)).endswith("ckpt_1")
+
+
+def test_dtype_mismatch_rejected(mesh8, tmp_path):
+    """Restoring into a template with different leaf dtypes must fail
+    loudly at restore time, not at the first engine dispatch."""
+    import jax.numpy as jnp
+    engine, state = _mlp_state(mesh8, seed=0)
+    path = C.save_checkpoint(str(tmp_path), state, 1)
+    bad = jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x,
+        state)
+    with pytest.raises(ValueError, match="dtype"):
+        C.restore_checkpoint(path, bad)
+
+
+def test_open_sweeps_stale_leftovers(mesh8, tmp_path):
+    engine, state = _mlp_state(mesh8, seed=0)
+    C.CheckpointEngine(str(tmp_path), async_write=False).save(state, 1)
+    # plant every debris species a crash can leave
+    os.makedirs(tmp_path / "ckpt_9")
+    (tmp_path / "ckpt_9" / "shard_0.msgpack").write_bytes(b"junk")
+    (tmp_path / "ckpt_4.msgpack.tmp.0").write_bytes(b"junk")
+    (tmp_path / "ckpt_1" / "shard_0.msgpack.tmp.0").write_bytes(b"junk")
+    C.CheckpointEngine(str(tmp_path), async_write=False)   # open -> sweep
+    names = {n for root, _d, fs in os.walk(tmp_path)
+             for n in fs + [os.path.basename(root)]}
+    assert not any(".tmp." in n for n in names), names
+    assert not (tmp_path / "ckpt_9").exists()
+    assert C.committed_epochs(str(tmp_path)) == [1]   # committed untouched
+
+
+def test_legacy_single_file_restores(mesh8, tmp_path):
+    """v1 single-msgpack checkpoints (pre-engine layout) still restore,
+    and a newer committed sharded epoch wins the listing over them."""
+    engine, state = _mlp_state(mesh8, seed=0)
+    C.save_checkpoint_legacy(str(tmp_path), state, 2)
+    latest = C.latest_checkpoint(str(tmp_path))
+    assert latest.endswith("ckpt_2.msgpack")
+    _, template = _mlp_state(mesh8, seed=1)
+    restored, epoch = C.restore_checkpoint(latest, template)
+    assert epoch == 2
+    _assert_trees_equal(restored, state)
+    C.save_checkpoint(str(tmp_path), state, 5)
+    assert C.committed_epochs(str(tmp_path)) == [2, 5]
+    assert C.latest_checkpoint(str(tmp_path)).endswith("ckpt_5")
+
+
+def test_reshard_restore_roundtrips_exact(devices, tmp_path):
+    """Save at one addressable-shard layout, restore into a template with
+    a DIFFERENT sharding (the single-process simulation of a host-count /
+    mesh change): a ZeRO-3-sharded save restores bit-exactly onto a
+    plain data-parallel template and vice versa."""
+    from functools import partial
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu.parallel.fsdp import fsdp_param_specs
     cfg = Config(model="mlp", epochs_local=1, batch_size=8,
                  compute_dtype="float32", augment=False)
-    engine = LocalSGDEngine(get_model("mlp", num_classes=10, hidden=8),
-                            mesh8, cfg)
-    x = np.zeros((8, 1, 8, 28, 28, 1), np.float32)
-    state = engine.init_state(jax.random.key(0), x[0, 0])
-    for e in range(1, 6):
-        C.save_checkpoint(str(tmp_path), state, e, keep=2)
-    assert C._list(str(tmp_path)) == [4, 5]
+    x = np.zeros((8, 28, 28, 1), np.float32)
+    mesh_f = build_mesh({"data": 2, "fsdp": 2}, devices[:4])
+    mesh_p = build_mesh({"data": 2}, devices[:2])
+    # hidden=32: the 784x32 kernel crosses fsdp's MIN_SHARD_ELEMS, so the
+    # save really does happen at a sharded-parameter layout
+    eng_f = LocalSGDEngine(get_model("mlp", num_classes=10, hidden=32),
+                           mesh_f, cfg,
+                           param_specs_fn=partial(fsdp_param_specs,
+                                                  axis="fsdp", axis_size=2))
+    eng_p = LocalSGDEngine(get_model("mlp", num_classes=10, hidden=32),
+                           mesh_p, cfg)
+    state_f = eng_f.init_state(jax.random.key(0), x)
+    state_p = eng_p.init_state(jax.random.key(1), x)
+    # fsdp-sharded save -> plain template
+    p1 = C.save_checkpoint(str(tmp_path / "a"), state_f, 1)
+    r1, _ = C.restore_checkpoint(p1, state_p)
+    _assert_trees_equal(r1, state_f)
+    for t, r in zip(jax.tree_util.tree_leaves(state_p),
+                    jax.tree_util.tree_leaves(r1)):
+        assert r.sharding == t.sharding
+    # plain save -> fsdp-sharded template
+    p2 = C.save_checkpoint(str(tmp_path / "b"), state_p, 1)
+    r2, _ = C.restore_checkpoint(p2, state_f)
+    _assert_trees_equal(r2, state_p)
+    specs = [str(l.sharding.spec)
+             for l in jax.tree_util.tree_leaves(r2.params)]
+    assert any("fsdp" in s for s in specs)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="ckpt_keep"):
+        Config(ckpt_keep=0)
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        Config(checkpoint_every=1)
+    with pytest.raises(ValueError, match="resume"):
+        Config(resume=True)
 
 
 def test_driver_resume_continues(mesh8, tmp_path):
@@ -48,8 +225,17 @@ def test_driver_resume_continues(mesh8, tmp_path):
               checkpoint_every=1, seed=2)
     res1 = train_global(Config(epochs_global=2, **kw), mesh=mesh8,
                         progress=False)
+    # round_timings carry the checkpoint walls every round (zero-filled
+    # convention); checkpoint_every=1 means every round paid a snapshot
+    # and its background write landed before results returned
+    for t in res1["round_timings"]:
+        assert t["ckpt_snapshot_ms"] > 0.0
+        assert t["ckpt_write_ms"] > 0.0
+    ck = res1["checkpoint"]
+    assert ck["enabled"] and ck["async"] and ck["layout"] == "sharded"
+    assert ck["saves"] == 2 and ck["bytes_per_host"] > 0
     # resume: run "4 epochs" but the first 2 come from the checkpoint
     res2 = train_global(Config(epochs_global=4, resume=True, **kw),
                         mesh=mesh8, progress=False)
     assert len(res2["global_train_losses"]) == 2  # only epochs 3 and 4 ran
-    assert C._list(str(tmp_path))[-1] == 4
+    assert C.committed_epochs(str(tmp_path))[-1] == 4
