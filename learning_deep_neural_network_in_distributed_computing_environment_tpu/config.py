@@ -174,6 +174,16 @@ class Config:
     sync_compression: str = "none"   # none | ef
     # Sharded-sync bucket size (MiB of fp32 parameters per collective).
     sync_bucket_mb: float = 4.0
+    # --- runtime sanitizer (ISSUE 6) ---------------------------------------
+    # sanitize: arm the round-loop correctness harness — the driver wraps
+    # every round dispatch/wait in jax.transfer_guard("disallow") (any
+    # IMPLICIT host<->device transfer in the hot path raises), enforces a
+    # zero-retrace budget after the warmup round (rounds 2..K must add no
+    # jaxpr traces or backend compiles), and asserts the donated round
+    # state's buffers were actually deleted by each engine call (missed
+    # donation silently doubles peak memory).  A clean run records all
+    # zeros in results["sanitize"].  Also armed by JAX_GRAFT_SANITIZE=1.
+    sanitize: bool = False
 
     def __post_init__(self) -> None:
         _choices("backend", self.backend, ("jax", "gloo", "nccl", "mpi"))
@@ -429,6 +439,12 @@ def build_argparser() -> argparse.ArgumentParser:
                         "aggregation)")
     p.add_argument("--sync_bucket_mb", type=float, default=d.sync_bucket_mb,
                    help="sharded-sync bucket size in MiB per collective")
+    p.add_argument("--sanitize", action="store_true", default=d.sanitize,
+                   help="arm the round-loop sanitizer: transfer guard "
+                        "around dispatch/wait (implicit transfers raise), "
+                        "zero-retrace budget after the warmup round, and "
+                        "donated-buffer deletion asserts (also via "
+                        "JAX_GRAFT_SANITIZE=1)")
     return p
 
 

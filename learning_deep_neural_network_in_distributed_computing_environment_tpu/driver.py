@@ -21,10 +21,12 @@ Returns the reference's twelve metric structures under their original names
 from __future__ import annotations
 
 import logging
+import os
 import sys
 import time
+from contextlib import contextmanager
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Sequence
+from typing import Any
 
 import jax
 import numpy as np
@@ -50,7 +52,7 @@ from . import checkpoint as ckpt_lib
 from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS,
                    build_mesh, initialize_distributed)
 from .models import get_model, is_attention_model, is_token_model
-from .train import LocalSGDEngine, TrainState, rank0_variables
+from .train import LocalSGDEngine, rank0_variables
 
 log = logging.getLogger(__name__)
 
@@ -111,6 +113,37 @@ def build_model_for(cfg: Config, num_classes: int, **extra):
     return get_model(cfg.model, num_classes=num_classes, dtype=dtype, **extra)
 
 
+@contextmanager
+def _round_guard(san: dict):
+    """Transfer guard around one round's dispatch/wait (ISSUE 6).
+
+    ``jax.transfer_guard("disallow")`` makes any IMPLICIT host<->device
+    transfer inside the guarded region raise — un-staged jit arguments,
+    bare-Python-scalar eager arithmetic on device arrays — while the
+    round loop's EXPLICIT staging (``device_put``/``device_get``/
+    ``jnp.asarray``) passes.  A violation is counted into
+    ``san["transfer_guard_violations"]`` before the error propagates,
+    so a crashed sanitized run still reports what tripped it.  No-op
+    when the sanitizer is off."""
+    if not san["enabled"]:
+        yield
+        return
+    try:
+        with jax.transfer_guard("disallow"):
+            yield
+    except Exception as e:  # noqa: BLE001 — classify, count, re-raise
+        # only the guard's own errors count ("Disallowed host-to-device
+        # transfer" / "Disallowed device-to-host transfer" / ...): an
+        # unrelated engine failure whose message merely contains
+        # "transfer" must not masquerade as a guard violation
+        msg = str(e).lower()
+        if "disallow" in msg and "transfer" in msg:
+            san["transfer_guard_violations"] += 1
+            log.error("sanitizer: implicit transfer in the round loop: %s",
+                      e)
+        raise
+
+
 def _measured_worker_walls(wall: float, n: int) -> np.ndarray:
     """Map this round's measured wall time onto the worker axis.
 
@@ -156,6 +189,26 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     # off (counts then stay zero); the per-run delta lands in results
     install_cache_counter()
     cache_counts0 = compile_cache_counts()
+    # --- runtime sanitizer (ISSUE 6) -----------------------------------
+    # --sanitize / JAX_GRAFT_SANITIZE=1: transfer guard around every
+    # round dispatch/wait, a zero-retrace budget for rounds after the
+    # warmup one, and donated-buffer deletion asserts.  All counters are
+    # zero on a clean run and land in results["sanitize"] either way.
+    sanitize = cfg.sanitize or (
+        os.environ.get("JAX_GRAFT_SANITIZE", "").strip().lower()
+        not in ("", "0", "false", "off", "no"))
+    san: dict[str, Any] = {"enabled": sanitize,
+                           "transfer_guard_violations": 0,
+                           "retrace_count": 0, "recompile_count": 0,
+                           "donation_failures": 0}
+    san_counter_ok = False
+    san_warmup: dict | None = None
+    if sanitize:
+        from .xla_flags import compile_event_counts, install_compile_counter
+        san_counter_ok = install_compile_counter()
+        if not san_counter_ok:
+            log.warning("sanitizer: trace/compile monitoring unavailable "
+                        "on this jax — the retrace budget is not enforced")
     if mesh is None:
         axes = cfg.mesh_axes()
         if cfg.num_workers:
@@ -634,8 +687,14 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     # completion marker (never the state itself — its buffers are donated
     # into the next round the moment it is dispatched).  Checkpoint rounds
     # and the final round still barrier (the save reads the state).
+    # Sanitize mode forces the barrier path: the deep pipeline defers a
+    # round's completion past its loop iteration, which would leave that
+    # round's wait outside the transfer guard and its donated buffers
+    # unchecked — the sanitizer's contract is every-round coverage, and
+    # it is a debugging harness, so determinism beats overlap here.
     deep_pipeline = (overlap and not streaming
-                     and jax.default_backend() != "cpu")
+                     and jax.default_backend() != "cpu"
+                     and not sanitize)
 
     def build_inputs(tparts, vparts, caps):
         if streaming:
@@ -827,11 +886,22 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                 # overlap exists to close (bench.py round_gap entry)
                 results["round_timings"][-2]["gap_ms"] = round(
                     (t_disp - t_ready) * 1e3, 3)
-            if streaming:
-                state, handle = engine.round_streamed_start(
-                    state, *prep["inputs"])
-            else:
-                state, handle = engine.round_start(state, *prep["inputs"])
+            # sanitizer donation probe: the packed round program donates
+            # its whole TrainState input — hold the pre-dispatch buffer
+            # refs so the post-wait check can assert XLA actually deleted
+            # them (the streamed path donates only the inner chunk carry,
+            # with lr_epoch deliberately read eagerly, so it is exempt)
+            donated_leaves = (
+                [l for l in jax.tree_util.tree_leaves(state)
+                 if isinstance(l, jax.Array)]
+                if sanitize and not streaming else None)
+            with _round_guard(san):
+                if streaming:
+                    state, handle = engine.round_streamed_start(
+                        state, *prep["inputs"])
+                else:
+                    state, handle = engine.round_start(
+                        state, *prep["inputs"])
             timing["stage_ms"] = round(
                 (time.perf_counter() - t_disp) * 1e3, 3)
             if engine.last_sync_stats:
@@ -865,7 +935,8 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                 timing["prep_ms"] = round(
                     (time.perf_counter() - t0) * 1e3, 3)
             if not defer:
-                state = engine.round_wait(state)
+                with _round_guard(san):
+                    state = engine.round_wait(state)
                 if engine.last_sync_stats:
                     timing.update(engine.last_sync_stats)
                 t_ready = time.perf_counter()
@@ -877,6 +948,22 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                 t_done_prev[0] = t_ready
                 record_walls(global_epoch, t_ready - start,
                              cur_steps_run, timing)
+                if donated_leaves is not None:
+                    # donation hygiene at runtime (graftlint R4's dynamic
+                    # twin): every leaf handed to the round program must
+                    # be gone now — a surviving buffer means XLA declined
+                    # the donation (sharding/layout mismatch) and the
+                    # round silently ran at double state memory
+                    fails = [i for i, l in enumerate(donated_leaves)
+                             if not l.is_deleted()]
+                    if fails:
+                        san["donation_failures"] += len(fails)
+                        raise RuntimeError(
+                            f"sanitizer: {len(fails)} of "
+                            f"{len(donated_leaves)} donated round-state "
+                            f"buffers survived round {global_epoch} — "
+                            "donation was declined (check in/out "
+                            "sharding match of the round program)")
             if not overlap:
                 metrics_job(handle, global_epoch, t_disp, timing)
                 if not last_round:
@@ -909,6 +996,15 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                 # resumes while the background thread serializes + commits.
                 ckpt_engine.save(engine.checkpoint_fence(state),
                                  global_epoch + 1, timing=timing)
+            if sanitize and san_warmup is None:
+                # retrace budget (graftlint R2's dynamic twin): the first
+                # round is the warmup — it legitimately traces+compiles
+                # the round (and sync) programs.  Every LATER round must
+                # add zero jaxpr traces and zero backend compiles; any
+                # delta means per-round retracing (shape churn, a
+                # rebuilt callable, value-varying static args) and is
+                # asserted on after the loop.
+                san_warmup = compile_event_counts()
     finally:
         try:
             if executor is not None:
@@ -953,6 +1049,37 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     results["checkpoint"] = (ckpt_engine.summary()
                              if ckpt_engine is not None
                              else {"enabled": False})
+
+    # sanitizer provenance (ISSUE 6): recorded like sync_engine — every
+    # run artifact states whether it ran sanitized and what the harness
+    # observed (all zeros on a clean run; enabled=False when off)
+    if sanitize and san_counter_ok and san_warmup is not None:
+        counts = compile_event_counts()
+        san["retrace_count"] = counts["traces"] - san_warmup["traces"]
+        san["recompile_count"] = (counts["compiles"]
+                                  - san_warmup["compiles"])
+    results["sanitize"] = san
+    if sanitize and (san["retrace_count"] or san["recompile_count"]):
+        raise RuntimeError(
+            f"sanitizer: retrace budget exceeded — rounds after the "
+            f"warmup added {san['retrace_count']} jaxpr trace(s) and "
+            f"{san['recompile_count']} backend compile(s); a steady-state "
+            "round loop must re-use its compiled programs (look for "
+            "shape churn in the packed inputs, per-round jit "
+            "construction, or value-varying static args)")
+    if sanitize:
+        # greppable clean-run provenance (any violation raised above).
+        # The "sanitizer clean" spelling is reserved for full coverage:
+        # when the monitoring surface was unavailable the retrace budget
+        # silently degraded to a no-op, and the line must say so —
+        # verify.sh's smoke greps the full-coverage spelling only.
+        if san_counter_ok:
+            log.info("sanitizer clean: 0 transfer-guard violations, 0 "
+                     "post-warmup retraces, 0 donation failures")
+        else:
+            log.info("sanitizer: 0 transfer-guard violations, 0 "
+                     "donation failures; retrace budget NOT enforced "
+                     "(jax monitoring unavailable)")
 
     results["state"] = state
     results["mesh"] = mesh

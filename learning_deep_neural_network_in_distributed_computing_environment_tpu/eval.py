@@ -59,7 +59,10 @@ def evaluate(model, variables, images: np.ndarray, labels: np.ndarray,
     steps = int(np.ceil(n / batch_size))
     x, y, m = pack_shard(images, labels, np.arange(n), batch_size, steps)
 
-    @jax.jit
+    # one-shot per evaluation: the whole test pass is ONE compiled scan
+    # closing over this call's (model, variables) — a shared cache entry
+    # could not hit across calls anyway
+    @jax.jit  # graftlint: disable=R2 -- single final-eval compile
     def run(x, y, m):
         def step(_, inp):
             xb, yb, mb = inp

@@ -44,6 +44,10 @@ def measure_step_time(model, variables, sample_batch: np.ndarray,
     num_batches = max(num_batches, 1)
     params = variables["params"]
     rest = {k: v for k, v in variables.items() if k != "params"}
+    # one-shot per probe: compiled once, the timed loop below reuses it
+    # (compile excluded from timing by design); a probe runs once per
+    # train_global with a run-specific model, so caching buys nothing
+    # graftlint: disable=R2 -- intentional single probe compile per run
     fn = jax.jit(fwd_bwd)
     x = jnp.asarray(sample_batch)
     jax.block_until_ready(fn(params, rest, x))  # compile

@@ -96,6 +96,13 @@ def _first_worker_row(x):
     first local worker — identical right after init (broadcast), which is
     the only place they consume it (probe)."""
     if not isinstance(x, jax.Array) or x.is_fully_addressable:
+        if isinstance(x, jax.Array):
+            # static slice instead of ``x[0]``: eager __getitem__ stages
+            # its gather index host->device IMPLICITLY every call, which
+            # the sanitizer's transfer guard rejects in the round loop
+            # (and which is a needless blocking H2D on TPU); a static
+            # slice bakes the index into the op instead
+            return lax.squeeze(lax.slice_in_dim(x, 0, 1, axis=0), (0,))
         return x[0]
     start = min((s.index[0].start or 0) for s in x.addressable_shards)
     covering = [s for s in x.addressable_shards
@@ -553,6 +560,8 @@ class LocalSGDEngine:
             opt_state = self.tx.init(params)
             return params, batch_stats, opt_state
 
+        # one-shot per engine: init runs exactly once per train_global
+        # graftlint: disable=R2 -- single Xavier-init trace, not a loop
         params, batch_stats, opt_state = jax.jit(_init)(rng)
 
         def tile(tree):
@@ -1442,8 +1451,20 @@ class LocalSGDEngine:
         while the next round computes.
         """
         cfg = self.cfg
-        zeros_like = jax.jit(
-            lambda p: jax.tree_util.tree_map(jnp.zeros_like, p))
+        # Fresh-grads program, built ONCE per engine (a per-call
+        # ``jax.jit(lambda ...)`` here was a graftlint R2 true positive:
+        # every round paid a fresh retrace+compile).  out_shardings pins
+        # the zeros to the params' shardings — zeros depend on no input,
+        # so GSPMD propagation has nothing to anchor on and an
+        # unconstrained program hands back UNSHARDED leaves, which the
+        # chunk program then silently reshards device-to-device every
+        # round (the sanitizer's transfer guard caught exactly that).
+        if "zeros" not in self._round_cache:
+            self._round_cache["zeros"] = jax.jit(
+                lambda p: jax.tree_util.tree_map(jnp.zeros_like, p),
+                out_shardings=jax.tree_util.tree_map(
+                    lambda x: x.sharding, state.params))
+        zeros_like = self._round_cache["zeros"]
 
         inner = (state.params, state.batch_stats, state.opt_state, state.rng,
                  zeros_like(state.params))
@@ -1451,9 +1472,18 @@ class LocalSGDEngine:
 
         per_epoch = []  # (train_chunk_ys, val_chunk_sums) device arrays
         for e in range(cfg.epochs_local):
-            lr = jnp.asarray(
+            # staged via an EXPLICIT device_put: jnp.asarray of a host
+            # PYTHON/numpy scalar is an implicit transfer
+            # (convert_element_type on the scalar) that the sanitizer's
+            # guard rejects in the round loop — a 0-d ndarray takes the
+            # explicit path on both branches.  Multi-host keeps the
+            # uncommitted asarray (device_put to a cross-process
+            # sharding is not portable on legacy jax).
+            lr_np = np.asarray(
                 steplr(cfg.lr, cfg.lr_gamma, cfg.lr_step_size, epoch0 + e),
-                jnp.float32)
+                np.float32)
+            lr = (jax.device_put(lr_np, NamedSharding(self.mesh, P()))
+                  if jax.process_count() == 1 else jnp.asarray(lr_np))
             # fresh zero grads each epoch: the round program resets the
             # last-grad carry per local epoch (scan init), match it
             if e > 0:
@@ -1514,10 +1544,18 @@ class LocalSGDEngine:
         # sync program's collectives alone
         self._sync_probe = (None, fence)
 
+        # the epoch bump runs as a tiny cached program: eager arithmetic
+        # with a Python/numpy scalar is an IMPLICIT host->device transfer
+        # every round — the sanitizer's transfer guard (ISSUE 6) rejects
+        # it, and on TPU it is a needless blocking H2D in the round loop.
+        # Inside jit the addend is a trace-time constant instead.
+        if "bump_epoch" not in self._round_cache:
+            self._round_cache["bump_epoch"] = jax.jit(
+                lambda e: e + jnp.asarray(cfg.epochs_local, e.dtype))
         new_state = TrainState(
             params=params, batch_stats=batch_stats, opt_state=opt_state,
-            lr_epoch=state.lr_epoch + cfg.epochs_local, rng=rng,
-            sync_residual=residual)
+            lr_epoch=self._round_cache["bump_epoch"](state.lr_epoch),
+            rng=rng, sync_residual=residual)
         return new_state, ("streamed", per_epoch, agg_grad_norm)
 
     def _assemble_streamed(self, per_epoch, agg_grad_norm) -> dict:

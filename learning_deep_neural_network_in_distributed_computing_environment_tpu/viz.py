@@ -14,7 +14,6 @@ is dumped to JSON next to where the PNG would go (headless parity).
 from __future__ import annotations
 
 import json
-import os
 from pathlib import Path
 
 

@@ -120,6 +120,49 @@ def compile_cache_counts() -> dict:
     return dict(_cache_counts)
 
 
+# --- trace/compile event telemetry (ISSUE 6 runtime sanitizer) -------------
+# Unlike the persistent-cache hit/miss counters above (which only fire when
+# the compilation cache is armed), jax emits trace/compile DURATION events on
+# every jaxpr trace and every backend compile, cache or no cache — exactly
+# the signal the sanitizer's per-round retrace budget needs: after the
+# warmup round, a healthy round loop performs ZERO new traces.
+
+_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_compile_event_counts = {"traces": 0, "compiles": 0}
+_compile_counter_installed = False
+
+
+def install_compile_counter() -> bool:
+    """Register a listener counting jaxpr traces and backend compiles.
+    Idempotent; returns False when the runtime lacks the monitoring
+    surface (counts then stay zero and the sanitizer's retrace budget
+    degrades to a no-op rather than a false alarm)."""
+    global _compile_counter_installed
+    if _compile_counter_installed:
+        return True
+    try:
+        from jax._src import monitoring
+
+        def _listen(event, duration, **kwargs):
+            if event == _TRACE_EVENT:
+                _compile_event_counts["traces"] += 1
+            elif event == _BACKEND_COMPILE_EVENT:
+                _compile_event_counts["compiles"] += 1
+
+        monitoring.register_event_duration_secs_listener(_listen)
+        _compile_counter_installed = True
+        return True
+    except Exception:  # noqa: BLE001 — telemetry only
+        return False
+
+
+def compile_event_counts() -> dict:
+    """Cumulative {traces, compiles} for this process (zeros until
+    ``install_compile_counter`` succeeds)."""
+    return dict(_compile_event_counts)
+
+
 def sequential_cpu_collectives_pinned() -> bool:
     """Whether XLA_FLAGS pins the SEQUENTIAL scheduler — used by the
     driver to fail fast instead of deadlocking when a hazardous

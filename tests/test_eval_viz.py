@@ -1,8 +1,6 @@
 """Evaluator metric parity vs sklearn + viz file outputs + CLI smoke."""
 
 import os
-import subprocess
-import sys
 
 import numpy as np
 import pytest

@@ -1,0 +1,588 @@
+"""graftlint rule fixtures: true positives AND true negatives per rule
+(R1-R5), suppression-comment + baseline-file behavior, and the two
+acceptance gates — the repo lints clean against its checked-in baseline,
+and an injected true positive flips the exit to non-zero."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools.graftlint.core import (_suppressed, _suppressions,
+                                  apply_baseline, lint_paths,
+                                  load_baseline, write_baseline, Finding)
+from tools.graftlint.rules import lint_source
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(
+    REPO, "learning_deep_neural_network_in_distributed_computing"
+          "_environment_tpu")
+BASELINE = os.path.join(REPO, "tools", "graftlint", "baseline.json")
+
+
+def rules_for(src: str) -> list[str]:
+    """Rule ids firing on a snippet, suppression comments honored."""
+    per_line, file_level = _suppressions(src)
+    return [r.rule for r in lint_source(src, "snippet.py")
+            if not _suppressed(r, per_line, file_level)]
+
+
+# --------------------------------------------------------------------
+# R1: host sync in traced regions
+# --------------------------------------------------------------------
+class TestR1HostSync:
+    def test_item_in_jit_flagged(self):
+        src = """
+import jax
+@jax.jit
+def f(x):
+    return x.item()
+"""
+        assert rules_for(src) == ["R1"]
+
+    def test_item_on_host_fn_clean(self):
+        src = """
+def host(x):
+    return x.item()
+"""
+        assert rules_for(src) == []
+
+    def test_np_asarray_on_traced_flagged(self):
+        src = """
+import jax, numpy as np
+def body(x):
+    return np.asarray(x) + 1
+g = jax.jit(body)
+"""
+        assert rules_for(src) == ["R1"]
+
+    def test_float_of_traced_flagged_but_static_float_clean(self):
+        src = """
+import jax, jax.numpy as jnp
+@jax.jit
+def f(x, k=4):
+    y = jnp.sum(x)
+    bad = float(y)
+    return bad
+def outer(self, x):
+    k = 3
+    good = float(k)   # host int -> host float, no sync
+    return good
+"""
+        assert rules_for(src) == ["R1"]
+
+    def test_implicit_bool_branch_flagged(self):
+        src = """
+import jax
+@jax.jit
+def f(x):
+    if x > 0:
+        return x
+    return -x
+"""
+        assert rules_for(src) == ["R1"]
+
+    def test_is_none_branch_clean(self):
+        src = """
+import jax
+@jax.jit
+def f(x, d=None):
+    if d is not None:
+        x = x + d
+    return x
+"""
+        assert rules_for(src) == []
+
+    def test_scan_body_is_traced(self):
+        src = """
+from jax import lax
+def run(xs):
+    def body(c, x):
+        return c, x.tolist()
+    return lax.scan(body, 0.0, xs)
+"""
+        assert rules_for(src) == ["R1"]
+
+
+# --------------------------------------------------------------------
+# R2: retrace hazards
+# --------------------------------------------------------------------
+class TestR2Retrace:
+    def test_jit_in_loop_flagged(self):
+        src = """
+import jax
+def run(xs):
+    out = []
+    for x in xs:
+        out.append(jax.jit(lambda a: a + 1)(x))
+    return out
+"""
+        assert "R2" in rules_for(src)
+
+    def test_module_scope_jit_clean(self):
+        src = """
+import jax
+f = jax.jit(lambda a: a + 1)
+def run(x):
+    return f(x)
+"""
+        assert rules_for(src) == []
+
+    def test_construct_and_call_flagged(self):
+        src = """
+import jax
+def run(g, x):
+    return jax.jit(g)(x)
+"""
+        assert rules_for(src) == ["R2"]
+
+    def test_local_jit_then_call_flagged(self):
+        src = """
+import jax
+def run(g, x):
+    fn = jax.jit(g)
+    return fn(x)
+"""
+        assert rules_for(src) == ["R2"]
+
+    def test_jit_decorated_local_def_then_call_flagged(self):
+        src = """
+import jax
+def evaluate(x):
+    @jax.jit
+    def run(a):
+        return a + 1
+    return run(x)
+"""
+        assert "R2" in rules_for(src)
+
+    def test_jit_decorated_module_def_clean(self):
+        src = """
+import jax
+@jax.jit
+def run(a):
+    return a + 1
+def evaluate(x):
+    return run(x)
+"""
+        assert rules_for(src) == []
+
+    def test_builder_returning_jit_clean(self):
+        src = """
+import jax
+def build(fn):
+    return jax.jit(fn, donate_argnums=(0,))
+"""
+        assert rules_for(src) == []
+
+    def test_unhashable_static_arg_flagged(self):
+        src = """
+import jax
+def f(a, b):
+    return a
+out = jax.jit(f, static_argnums=(1,))(1, [2, 3])
+"""
+        assert "R2" in rules_for(src)
+
+
+# --------------------------------------------------------------------
+# R3: collective axis-name vocabulary
+# --------------------------------------------------------------------
+class TestR3AxisNames:
+    def test_unknown_axis_flagged(self):
+        src = """
+from jax import lax
+def body(x):
+    return lax.psum(x, "workers")
+"""
+        assert rules_for(src) == ["R3"]
+
+    def test_vocabulary_axes_clean(self):
+        src = """
+from jax import lax
+def body(x):
+    y = lax.pmean(x, "data")
+    return lax.psum(y, ("data", "model"))
+"""
+        assert rules_for(src) == []
+
+    def test_axis_constant_name_clean(self):
+        src = """
+from jax import lax
+from pkg.mesh import DATA_AXIS
+def body(x):
+    return lax.psum(x, DATA_AXIS)
+"""
+        assert rules_for(src) == []
+
+    def test_tuple_with_typo_flagged(self):
+        src = """
+from jax import lax
+def body(x):
+    return lax.pmean(x, ("data", "modl"))
+"""
+        assert rules_for(src) == ["R3"]
+
+    def test_axis_outside_enclosing_shard_map_specs_flagged(self):
+        # mesh is a VARIABLE (as in all real call sites): the check keys
+        # on the statically-visible specs alone
+        src = """
+import jax
+from jax.sharding import PartitionSpec as P
+from jax import lax
+
+def inner(x):
+    return lax.psum(x, "model")
+
+prog = jax.shard_map(inner, mesh=mesh, in_specs=(P("data"),),
+                     out_specs=P("data"))
+"""
+        assert rules_for(src) == ["R3"]
+
+    def test_dynamic_specs_skip_subset_check(self):
+        src = """
+import jax
+from jax import lax
+
+def inner(x):
+    return lax.psum(x, "model")
+
+prog = jax.shard_map(inner, mesh=mesh, in_specs=tuple(specs),
+                     out_specs=out)
+"""
+        assert rules_for(src) == []
+
+
+# --------------------------------------------------------------------
+# R4: donation hygiene
+# --------------------------------------------------------------------
+class TestR4Donation:
+    def test_use_after_donate_flagged(self):
+        src = """
+import jax
+def step(g, state, x):
+    f = jax.jit(g, donate_argnums=(0,))
+    out = f(state, x)
+    return state  # graftlint reads the donated buffer again
+"""
+        assert "R4" in rules_for(src)
+
+    def test_rebound_donated_name_clean(self):
+        src = """
+import jax
+def step(g, state, x):
+    f = jax.jit(g, donate_argnums=(0,))
+    state = f(state, x)
+    return state
+"""
+        assert "R4" not in rules_for(src)
+
+    def test_rebinding_in_later_statement_clears_donated_name(self):
+        src = """
+import jax
+def step(g, state, x):
+    f = jax.jit(g, donate_argnums=(0,))
+    out = f(state, x)
+    state = out[0]
+    return state  # reads the NEW binding, not the donated buffer
+"""
+        assert "R4" not in rules_for(src)
+
+    def test_read_of_donated_name_before_rebind_still_flagged(self):
+        src = """
+import jax
+def step(g, state, x):
+    f = jax.jit(g, donate_argnums=(0,))
+    out = f(state, x)
+    norm = state.sum()   # donated buffer read BEFORE the rebind
+    state = out[0]
+    return state, norm
+"""
+        assert "R4" in rules_for(src)
+
+    def test_jit_of_shard_map_without_donation_flagged(self):
+        src = """
+import jax
+from jax import shard_map
+fn = shard_map(lambda x: x, mesh=None, in_specs=None, out_specs=None)
+prog = jax.jit(fn)
+"""
+        assert rules_for(src) == ["R4"]
+
+    def test_jit_of_shard_map_with_donation_clean(self):
+        src = """
+import jax
+from jax import shard_map
+fn = shard_map(lambda x: x, mesh=None, in_specs=None, out_specs=None)
+prog = jax.jit(fn, donate_argnums=(0,))
+"""
+        assert rules_for(src) == []
+
+    def test_rebound_name_no_longer_shard_map_clean(self):
+        src = """
+import jax
+from jax import shard_map
+fn = shard_map(lambda x: x, mesh=None, in_specs=None, out_specs=None)
+prog = jax.jit(fn, donate_argnums=(0,))
+fn = make_plain_step()
+other = jax.jit(fn)
+"""
+        assert rules_for(src) == []
+
+    def test_jit_before_shard_map_assignment_not_matched(self):
+        src = """
+import jax
+from jax import shard_map
+fn = make_plain_step()
+prog = jax.jit(fn)
+fn = shard_map(lambda x: x, mesh=None, in_specs=None, out_specs=None)
+"""
+        assert rules_for(src) == []
+
+
+# --------------------------------------------------------------------
+# R5: dtype-promotion traps
+# --------------------------------------------------------------------
+class TestR5DtypeTraps:
+    def test_np_float64_in_traced_flagged(self):
+        src = """
+import jax, numpy as np
+@jax.jit
+def f(x):
+    return x * np.float64(0.5)
+"""
+        assert rules_for(src) == ["R5"]
+
+    def test_astype_builtin_float_flagged(self):
+        src = """
+import jax
+@jax.jit
+def f(x):
+    return x.astype(float)
+"""
+        assert rules_for(src) == ["R5"]
+
+    def test_zeros_like_scan_carry_flagged(self):
+        src = """
+import jax, jax.numpy as jnp
+from jax import lax
+@jax.jit
+def f(xs):
+    def body(c, x):
+        return c + x, None
+    out, _ = lax.scan(body, jnp.zeros_like(xs[0]), xs)
+    return out
+"""
+        assert rules_for(src) == ["R5"]
+
+    def test_zeros_like_with_pinned_dtype_clean(self):
+        src = """
+import jax, jax.numpy as jnp
+from jax import lax
+@jax.jit
+def f(xs):
+    def body(c, x):
+        return c + x, None
+    out, _ = lax.scan(
+        body, jnp.zeros_like(xs[0], dtype=jnp.float32), xs)
+    return out
+"""
+        assert rules_for(src) == []
+
+    def test_zeros_like_with_positional_dtype_clean(self):
+        src = """
+import jax, jax.numpy as jnp
+from jax import lax
+@jax.jit
+def f(xs):
+    def body(c, x):
+        return c + x, None
+    out, _ = lax.scan(body, jnp.zeros_like(xs[0], jnp.float32), xs)
+    return out
+"""
+        assert rules_for(src) == []
+
+
+# --------------------------------------------------------------------
+# Suppression comments
+# --------------------------------------------------------------------
+class TestSuppression:
+    BAD = """
+import jax
+@jax.jit
+def f(x):
+    return x.item(){comment}
+"""
+
+    def test_same_line_disable(self):
+        src = self.BAD.format(
+            comment="  # graftlint: disable=R1 -- fixture")
+        assert rules_for(src) == []
+
+    def test_line_above_disable(self):
+        src = """
+import jax
+@jax.jit
+def f(x):
+    # graftlint: disable=R1 -- fixture
+    return x.item()
+"""
+        assert rules_for(src) == []
+
+    def test_wrong_rule_does_not_suppress(self):
+        src = self.BAD.format(comment="  # graftlint: disable=R3")
+        assert rules_for(src) == ["R1"]
+
+    def test_disable_all(self):
+        src = self.BAD.format(comment="  # graftlint: disable=all")
+        assert rules_for(src) == []
+
+    def test_file_level_disable(self):
+        src = "# graftlint: disable-file=R1\n" + self.BAD.format(comment="")
+        assert rules_for(src) == []
+
+    def test_comment_inside_string_is_not_a_suppression(self):
+        src = """
+import jax
+@jax.jit
+def f(x):
+    s = "# graftlint: disable=R1"
+    return x.item()
+"""
+        assert rules_for(src) == ["R1"]
+
+
+# --------------------------------------------------------------------
+# Baseline behavior
+# --------------------------------------------------------------------
+class TestBaseline:
+    def _findings(self, tmp_path, src):
+        p = tmp_path / "mod.py"
+        p.write_text(src)
+        return lint_paths([str(p)], repo_root=str(tmp_path))
+
+    BAD = """
+import jax
+@jax.jit
+def f(x):
+    return x.item()
+"""
+
+    def test_baselined_finding_is_consumed(self, tmp_path):
+        findings = self._findings(tmp_path, self.BAD)
+        assert [f.rule for f in findings] == ["R1"]
+        bl_path = tmp_path / "baseline.json"
+        write_baseline(findings, str(bl_path))
+        new, accepted = apply_baseline(
+            self._findings(tmp_path, self.BAD), load_baseline(str(bl_path)))
+        assert new == [] and len(accepted) == 1
+        assert accepted[0].baselined
+
+    def test_extra_finding_on_top_of_baseline_reported(self, tmp_path):
+        findings = self._findings(tmp_path, self.BAD)
+        bl_path = tmp_path / "baseline.json"
+        write_baseline(findings, str(bl_path))
+        worse = self.BAD + """
+@jax.jit
+def g(x):
+    return x.tolist()
+"""
+        new, accepted = apply_baseline(
+            self._findings(tmp_path, worse), load_baseline(str(bl_path)))
+        assert len(accepted) == 1
+        assert [f.rule for f in new] == ["R1"]
+        assert "tolist" in new[0].line_text
+
+    def test_line_drift_does_not_invalidate_baseline(self, tmp_path):
+        findings = self._findings(tmp_path, self.BAD)
+        bl_path = tmp_path / "baseline.json"
+        write_baseline(findings, str(bl_path))
+        shifted = "\n\n\n# moved down\n" + self.BAD
+        new, accepted = apply_baseline(
+            self._findings(tmp_path, shifted), load_baseline(str(bl_path)))
+        assert new == [] and len(accepted) == 1
+
+    def test_overlapping_paths_lint_each_file_once(self, tmp_path):
+        p = tmp_path / "mod.py"
+        p.write_text(self.BAD)
+        findings = lint_paths([str(tmp_path), str(p)],
+                              repo_root=str(tmp_path))
+        assert len(findings) == 1  # dir + file-in-dir is ONE lint
+
+    def test_unparseable_file_reports_not_crashes(self, tmp_path):
+        p = tmp_path / "broken.py"
+        p.write_text("def f():\n        x = 1\n      y = 2\n")
+        findings = lint_paths([str(p)], repo_root=str(tmp_path))
+        assert [f.rule for f in findings] == ["R2"]
+        assert "does not parse" in findings[0].message
+
+    def test_scoped_write_baseline_keeps_other_files_entries(
+            self, tmp_path):
+        a, b = tmp_path / "a.py", tmp_path / "b.py"
+        a.write_text(self.BAD)
+        b.write_text(self.BAD)
+        bl_path = tmp_path / "baseline.json"
+        write_baseline(lint_paths([str(tmp_path)],
+                                  repo_root=str(tmp_path)), str(bl_path))
+        # re-write from a NARROWER scope: b.py's entry must survive
+        old = load_baseline(str(bl_path))
+        write_baseline(lint_paths([str(a)], repo_root=str(tmp_path)),
+                       str(bl_path), old, scoped_files={"a.py"})
+        kept = load_baseline(str(bl_path))
+        assert ("b.py", "R1", "return x.item()") in kept.entries
+
+    def test_justifications_carry_over_on_rewrite(self, tmp_path):
+        findings = self._findings(tmp_path, self.BAD)
+        bl_path = tmp_path / "baseline.json"
+        write_baseline(findings, str(bl_path))
+        data = json.loads(bl_path.read_text())
+        data["entries"][0]["justification"] = "known metric readback"
+        bl_path.write_text(json.dumps(data))
+        write_baseline(self._findings(tmp_path, self.BAD), str(bl_path),
+                       load_baseline(str(bl_path)))
+        data2 = json.loads(bl_path.read_text())
+        assert data2["entries"][0]["justification"] == \
+            "known metric readback"
+
+
+# --------------------------------------------------------------------
+# Acceptance gates
+# --------------------------------------------------------------------
+class TestRepoGate:
+    def test_package_lints_clean_against_checked_in_baseline(self):
+        findings = lint_paths([PKG], repo_root=REPO)
+        new, _ = apply_baseline(findings, load_baseline(BASELINE))
+        assert new == [], "\n".join(str(f) for f in new)
+
+    def test_cli_exit_codes(self, tmp_path):
+        env = dict(os.environ, PYTHONPATH=REPO)
+        clean = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint"],
+            cwd=REPO, env=env, capture_output=True, text=True)
+        assert clean.returncode == 0, clean.stdout + clean.stderr
+        bad = tmp_path / "injected.py"
+        bad.write_text("""
+import jax
+@jax.jit
+def f(x):
+    return x.item()
+""")
+        injected = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", PKG, str(bad)],
+            cwd=REPO, env=env, capture_output=True, text=True)
+        assert injected.returncode == 1, injected.stdout + injected.stderr
+        assert "R1" in injected.stdout
+
+    def test_axis_vocab_discovered_from_mesh_py(self):
+        from tools.graftlint.core import discover_axis_vocab
+        vocab, constants = discover_axis_vocab([PKG])
+        assert {"data", "model", "pipe", "seq", "expert",
+                "fsdp"} <= set(vocab)
+        assert constants.get("DATA_AXIS") == "data"
+
+    def test_finding_str_and_key(self):
+        f = Finding("a.py", 3, 1, "R1", "msg", "  x.item()  ")
+        assert f.key == ("a.py", "R1", "x.item()")
+        assert "a.py:3:1: R1 msg" == str(f)

@@ -1,0 +1,37 @@
+"""graftlint: JAX-hazard static analysis for the jax_graft package.
+
+An AST lint pass with five JAX-specific rule families (docs/LINT.md is
+the catalog):
+
+- R1  host-sync calls inside traced regions (``.item()``/``.tolist()``/
+      ``np.asarray``/``float()`` on traced values, implicit ``bool()``
+      branches) — each one is a device->host round trip that stalls the
+      TPU pipeline, or a trace-time error waiting for a shape change;
+- R2  retrace hazards (``jax.jit`` constructed inside loops or
+      constructed-and-called per invocation, unhashable static args) —
+      every retrace pays trace+lower+compile wall again;
+- R3  collective axis names validated against the ``mesh.py`` axis
+      vocabulary and, where statically visible, the enclosing
+      ``shard_map`` specs — a wrong axis name is a trace error at best
+      and a silently-wrong reduction at worst;
+- R4  donation hygiene (donated buffers reused after the call,
+      jit-of-shard_map engine entry points without ``donate_argnums``) —
+      missed donation doubles peak memory of every engine step;
+- R5  dtype-promotion traps (float64 constructors / ``dtype=float`` in
+      traced code, ``zeros_like`` accumulator carries that inherit a
+      low-precision dtype).
+
+Suppression: ``# graftlint: disable=R1`` (same line or the line above;
+comma-separated rule list; ``disable=all`` silences every rule) and
+``# graftlint: disable-file=R3`` anywhere in a file for file-level
+scope.  Pre-existing accepted findings live in ``baseline.json`` next
+to this module so CI gates only on NEW findings.
+
+CLI::
+
+    python -m tools.graftlint [paths...] [--baseline FILE]
+        [--write-baseline] [--no-baseline] [--format text|json]
+"""
+
+from .core import Finding, lint_paths, load_baseline, apply_baseline  # noqa: F401
+from .rules import RULES, lint_source  # noqa: F401

@@ -1,0 +1,80 @@
+"""CLI: ``python -m tools.graftlint [paths...]``.
+
+Exit status: 0 when every finding is suppressed or baselined, 1 when
+new findings exist (they are printed), 2 on usage errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import (apply_baseline, lint_paths, load_baseline,
+                   write_baseline)
+from .rules import RULES
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(os.path.dirname(_HERE))
+DEFAULT_BASELINE = os.path.join(_HERE, "baseline.json")
+DEFAULT_TARGET = os.path.join(
+    _REPO, "learning_deep_neural_network_in_distributed_computing"
+           "_environment_tpu")
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="graftlint",
+        description="JAX-hazard static analysis (rules R1-R5; see "
+                    "docs/LINT.md)")
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/dirs to lint (default: the package)")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline JSON of accepted findings")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignore the baseline")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="accept all current findings into --baseline "
+                        "(justifications for surviving keys carry over)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--list-rules", action="store_true")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in sorted(RULES.items()):
+            print(f"{rule}: {desc}")
+        return 0
+
+    paths = args.paths or [DEFAULT_TARGET]
+    findings = lint_paths(paths, repo_root=_REPO)
+
+    if args.write_baseline:
+        from .core import _py_files
+        old = load_baseline(args.baseline)
+        scoped = {os.path.relpath(f, _REPO) for f in _py_files(paths)}
+        write_baseline(findings, args.baseline, old, scoped_files=scoped)
+        print(f"graftlint: wrote {len(findings)} finding(s) to "
+              f"{os.path.relpath(args.baseline, _REPO)} "
+              f"(entries outside the {len(scoped)} linted files kept)")
+        return 0
+
+    baseline = (load_baseline(args.baseline) if not args.no_baseline
+                else load_baseline(""))
+    new, accepted = apply_baseline(findings, baseline)
+
+    if args.format == "json":
+        print(json.dumps({
+            "new": [vars(f) for f in new],
+            "baselined": [vars(f) for f in accepted],
+        }, indent=1, default=str))
+    else:
+        for f in new:
+            print(f)
+        print(f"graftlint: {len(new)} new finding(s), "
+              f"{len(accepted)} baselined, rules {'/'.join(sorted(RULES))}")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

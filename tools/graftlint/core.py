@@ -1,0 +1,243 @@
+"""Runner: file discovery, suppression comments, baseline, reporting.
+
+Suppression syntax (parsed from real COMMENT tokens, so strings never
+match):
+
+- ``# graftlint: disable=R1`` or ``# graftlint: disable=R1,R4`` on the
+  flagged line or the line directly above (``all`` silences every rule;
+  free text after the rule list — a justification — is encouraged);
+- ``# graftlint: disable-file=R2`` anywhere in the file for file scope.
+
+Baseline: a checked-in JSON of accepted pre-existing findings keyed by
+``(file, rule, stripped source line)`` with a count — line-number drift
+never invalidates an entry, and a new finding on an already-baselined
+line is caught as soon as the count is exceeded.  CI gates only on
+findings NOT consumed by the baseline.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+from .rules import DEFAULT_AXIS_VOCAB, RawFinding, lint_source
+
+_DISABLE_RE = re.compile(
+    r"#\s*graftlint:\s*(disable(?:-file)?)\s*=\s*"
+    r"((?:R\d+|all)(?:\s*,\s*(?:R\d+|all))*)", re.IGNORECASE)
+_AXIS_CONST_RE = re.compile(
+    r'^([A-Z][A-Z0-9_]*_AXIS)\s*=\s*["\']([a-z0-9_]+)["\']', re.MULTILINE)
+
+
+@dataclass
+class Finding:
+    """One reportable finding (post-suppression)."""
+
+    file: str
+    line: int
+    col: int
+    rule: str
+    message: str
+    line_text: str = ""
+    baselined: bool = False
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        return (self.file, self.rule, self.line_text.strip())
+
+    def __str__(self) -> str:
+        mark = " [baselined]" if self.baselined else ""
+        return f"{self.file}:{self.line}:{self.col}: {self.rule}{mark} " \
+               f"{self.message}"
+
+
+def _suppressions(src: str) -> tuple[dict[int, set[str]], set[str]]:
+    """(line -> suppressed rules, file-level suppressed rules)."""
+    per_line: dict[int, set[str]] = {}
+    file_level: set[str] = set()
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(src).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _DISABLE_RE.search(tok.string)
+            if not m:
+                continue
+            rules = {r.strip().upper() if r.strip().lower() != "all"
+                     else "ALL" for r in m.group(2).split(",")}
+            if m.group(1).lower() == "disable-file":
+                file_level |= rules
+            else:
+                per_line.setdefault(tok.start[0], set()).update(rules)
+    except (tokenize.TokenError, SyntaxError):
+        # unparseable files still get their graceful R2 "does not
+        # parse" finding from rules.lint_source — a tokenizer error
+        # (IndentationError is a SyntaxError subclass) must not kill
+        # the whole lint run
+        pass
+    return per_line, file_level
+
+
+def _suppressed(raw: RawFinding, per_line: dict[int, set[str]],
+                file_level: set[str]) -> bool:
+    if "ALL" in file_level or raw.rule in file_level:
+        return True
+    for ln in (raw.line, raw.line - 1):
+        rules = per_line.get(ln)
+        if rules and ("ALL" in rules or raw.rule in rules):
+            return True
+    return False
+
+
+def discover_axis_vocab(paths: list[str]) -> tuple[frozenset[str],
+                                                   dict[str, str]]:
+    """Mesh axis vocabulary from any ``mesh.py`` under the lint paths:
+    values of ``X_AXIS = "name"`` constants.  Falls back to the default
+    vocabulary when none is found.  Also returns the constant-name ->
+    axis-name map (for resolving ``DATA_AXIS`` spellings in specs)."""
+    vocab: set[str] = set()
+    constants: dict[str, str] = {}
+    for path in paths:
+        candidates = []
+        if os.path.isfile(path) and os.path.basename(path) == "mesh.py":
+            candidates = [path]
+        elif os.path.isdir(path):
+            for root, _dirs, files in os.walk(path):
+                if "mesh.py" in files:
+                    candidates.append(os.path.join(root, "mesh.py"))
+        for c in candidates:
+            try:
+                with open(c, encoding="utf-8") as f:
+                    src = f.read()
+            except OSError:
+                continue
+            for m in _AXIS_CONST_RE.finditer(src):
+                constants[m.group(1)] = m.group(2)
+                vocab.add(m.group(2))
+    if not vocab:
+        return DEFAULT_AXIS_VOCAB, constants
+    return frozenset(vocab), constants
+
+
+def _py_files(paths: list[str]) -> list[str]:
+    out: list[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            out.append(os.path.abspath(path))
+        elif os.path.isdir(path):
+            for root, dirs, files in os.walk(path):
+                dirs[:] = [d for d in dirs
+                           if d not in ("__pycache__", ".git",
+                                        ".jax_cache")]
+                out.extend(os.path.abspath(os.path.join(root, f))
+                           for f in sorted(files) if f.endswith(".py"))
+    # overlapping path arguments (a dir plus a file inside it) must not
+    # lint a file twice — duplicates would double-consume baseline counts
+    return list(dict.fromkeys(out))
+
+
+def lint_paths(paths: list[str], *, repo_root: str | None = None,
+               axis_vocab: frozenset[str] | None = None
+               ) -> list[Finding]:
+    """Lint every .py file under ``paths``; returns suppression-filtered
+    findings (baseline not yet applied) with repo-relative file names."""
+    root = repo_root or os.getcwd()
+    if axis_vocab is None:
+        axis_vocab, constants = discover_axis_vocab(paths)
+    else:
+        _, constants = discover_axis_vocab(paths)
+    findings: list[Finding] = []
+    for fpath in _py_files(paths):
+        try:
+            with open(fpath, encoding="utf-8") as f:
+                src = f.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        rel = os.path.relpath(os.path.abspath(fpath), root)
+        per_line, file_level = _suppressions(src)
+        lines = src.splitlines()
+        for raw in lint_source(src, rel, axis_vocab, constants):
+            if _suppressed(raw, per_line, file_level):
+                continue
+            text = lines[raw.line - 1] if 0 < raw.line <= len(lines) else ""
+            findings.append(Finding(rel, raw.line, raw.col, raw.rule,
+                                    raw.message, text))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings
+
+
+# ---------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------
+
+@dataclass
+class Baseline:
+    entries: dict[tuple[str, str, str], int] = field(default_factory=dict)
+    justifications: dict[tuple[str, str, str], str] = field(
+        default_factory=dict)
+
+    def to_json(self) -> dict:
+        return {"version": 1, "entries": [
+            {"file": f, "rule": r, "key": k, "count": c,
+             "justification": self.justifications.get((f, r, k), "")}
+            for (f, r, k), c in sorted(self.entries.items())]}
+
+
+def load_baseline(path: str) -> Baseline:
+    bl = Baseline()
+    if not path or not os.path.exists(path):
+        return bl
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    for e in data.get("entries", []):
+        key = (e["file"], e["rule"], e["key"])
+        bl.entries[key] = bl.entries.get(key, 0) + int(e.get("count", 1))
+        if e.get("justification"):
+            bl.justifications[key] = e["justification"]
+    return bl
+
+
+def apply_baseline(findings: list[Finding], baseline: Baseline
+                   ) -> tuple[list[Finding], list[Finding]]:
+    """Split findings into (new, baselined), consuming baseline counts."""
+    budget = dict(baseline.entries)
+    new: list[Finding] = []
+    accepted: list[Finding] = []
+    for f in findings:
+        if budget.get(f.key, 0) > 0:
+            budget[f.key] -= 1
+            f.baselined = True
+            accepted.append(f)
+        else:
+            new.append(f)
+    return new, accepted
+
+
+def write_baseline(findings: list[Finding], path: str,
+                   old: Baseline | None = None,
+                   scoped_files: set[str] | None = None) -> None:
+    """Serialize current findings as the new baseline, carrying over
+    justifications for keys that survive.
+
+    ``scoped_files``: the repo-relative files this lint run actually
+    covered.  Old entries for files OUTSIDE that set are preserved
+    verbatim — rewriting the baseline from a narrower path argument must
+    not silently discard every other file's accepted findings."""
+    bl = Baseline()
+    for f in findings:
+        bl.entries[f.key] = bl.entries.get(f.key, 0) + 1
+        if old is not None and f.key in old.justifications:
+            bl.justifications[f.key] = old.justifications[f.key]
+    if old is not None and scoped_files is not None:
+        for key, count in old.entries.items():
+            if key[0] not in scoped_files:
+                bl.entries[key] = count
+                if key in old.justifications:
+                    bl.justifications[key] = old.justifications[key]
+    with open(path, "w", encoding="utf-8") as fp:
+        json.dump(bl.to_json(), fp, indent=1)
+        fp.write("\n")
