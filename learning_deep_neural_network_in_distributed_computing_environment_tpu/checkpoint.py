@@ -165,10 +165,17 @@ class CheckpointEngine:
     attributed per round."""
 
     def __init__(self, ckpt_dir: str, keep: int = 3,
-                 async_write: bool = True):
+                 async_write: bool = True,
+                 metadata: dict | None = None):
         self.dir = ckpt_dir
         self.keep = max(1, int(keep))
         self.async_write = bool(async_write)
+        # run-provenance / arch facts published into every MANIFEST.json
+        # (ISSUE 7 satellite): JSON-able dict, identical on every process
+        # (it comes from the shared Config), so the every-process manifest
+        # write stays byte-identical.  ``manifest_metadata`` reads it back;
+        # serve self-configures the model from it.
+        self.metadata = dict(metadata) if metadata else {}
         os.makedirs(ckpt_dir, exist_ok=True)
         self._sweep_stale()
         self._pool = None         # writer thread, spawned at first save
@@ -361,6 +368,8 @@ class CheckpointEngine:
         d = os.path.join(self.dir, f"ckpt_{epoch}")
         manifest = {"format": FORMAT, "global_epoch": int(epoch),
                     "process_count": pc, "shards": shards, "leaves": meta}
+        if self.metadata:
+            manifest["metadata"] = self.metadata
         path = os.path.join(d, MANIFEST)
         tmp = f"{path}.tmp.{jax.process_index()}"
         with open(tmp, "w") as f:
@@ -487,6 +496,25 @@ def committed_epochs(ckpt_dir: str) -> list[int]:
                   | set(_legacy_epochs(ckpt_dir)))
 
 
+def manifest_metadata(path: str) -> dict:
+    """The ``metadata`` block a save's engine published into MANIFEST.json
+    (model family + arch Config fields — ISSUE 7 satellite), or ``{}``
+    for pre-metadata and legacy checkpoints.
+
+    ``path`` is a committed ``ckpt_<E>`` epoch dir or a checkpoint root
+    (resolved to the newest committed sharded epoch).  Read-only and
+    local — no multi-host agreement collective, so inspection tools and
+    the single-process serve path can call it freely."""
+    manifest = _read_manifest(path)
+    if manifest is None:
+        epochs = _sharded_epochs(path)
+        if not epochs:
+            return {}
+        manifest = _read_manifest(
+            os.path.join(path, f"ckpt_{epochs[-1]}"))
+    return dict((manifest or {}).get("metadata", {}))
+
+
 def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
     """Path of the newest COMMITTED checkpoint, agreed across hosts.
 
@@ -604,13 +632,14 @@ def _reshard_leaf(tmpl, val):
 # ----------------------------------------------------------------------
 
 def save_checkpoint(ckpt_dir: str, state, global_epoch: int,
-                    keep: int = 3) -> str:
+                    keep: int = 3, metadata: dict | None = None) -> str:
     """Blocking sharded save (module-level convenience; the driver holds a
     long-lived ``CheckpointEngine`` instead).  EVERY process must call
     this — the commit barrier is collective.  Note the transient engine's
     open-time sweep: do not mix with a concurrently-writing async engine
     on the same directory."""
-    eng = CheckpointEngine(ckpt_dir, keep=keep, async_write=False)
+    eng = CheckpointEngine(ckpt_dir, keep=keep, async_write=False,
+                           metadata=metadata)
     return eng.save(state, global_epoch)
 
 

@@ -184,6 +184,23 @@ class Config:
     # donation silently doubles peak memory).  A clean run records all
     # zeros in results["sanitize"].  Also armed by JAX_GRAFT_SANITIZE=1.
     sanitize: bool = False
+    # --- serving engine (ISSUE 7: `main.py serve`) -------------------------
+    # Continuous-batching inference off a sharded checkpoint: the model
+    # self-configures from the checkpoint's MANIFEST metadata
+    # (--checkpoint_dir points at the run's checkpoint root or one
+    # committed ckpt_<E> dir); these knobs shape the two compiled
+    # programs (per-bucket prefill + one fixed-batch decode step) and the
+    # paged KV cache behind them.
+    serve_max_batch: int = 4      # decode slots (the fixed decode shape)
+    serve_page_size: int = 16     # tokens per KV-cache page
+    serve_max_pages: int = 64     # page-pool size (page 0 = trash page)
+    serve_prompt_buckets: str = "16,64"  # prefill program lengths, csv
+    serve_eos_id: int = -1        # sampling this id evicts (-1 = off)
+    serve_max_new_tokens: int = 16  # per-request generation budget
+    serve_temperature: float = 0.0  # 0 = greedy
+    serve_requests: int = 8       # synthetic requests when no prompt given
+    serve_prompt: str = ""        # fixed prompt (csv token ids) for all
+    #                               requests; "" = per-request synthetic
 
     def __post_init__(self) -> None:
         _choices("backend", self.backend, ("jax", "gloo", "nccl", "mpi"))
@@ -233,6 +250,23 @@ class Config:
         if self.sync_bucket_mb <= 0:
             raise ValueError(
                 f"sync_bucket_mb must be positive, got {self.sync_bucket_mb}")
+        if self.serve_max_batch < 1 or self.serve_page_size < 1:
+            raise ValueError(
+                f"serve_max_batch ({self.serve_max_batch}) and "
+                f"serve_page_size ({self.serve_page_size}) must be >= 1")
+        if self.serve_max_pages < 2:
+            raise ValueError(
+                f"serve_max_pages must be >= 2 (page 0 is the reserved "
+                f"trash page), got {self.serve_max_pages}")
+        if self.serve_max_new_tokens < 1 or self.serve_requests < 1:
+            raise ValueError(
+                "serve_max_new_tokens and serve_requests must be >= 1, "
+                f"got {self.serve_max_new_tokens}/{self.serve_requests}")
+        if self.serve_temperature < 0.0:
+            raise ValueError(
+                f"serve_temperature must be >= 0 (0 = greedy), got "
+                f"{self.serve_temperature}")
+        self.parse_prompt_buckets()   # validates the csv eagerly
         if not 0.0 <= self.local_weight <= 1.0:
             raise ValueError(f"local_weight must be in [0,1], got {self.local_weight}")
         if not 0.0 <= self.fixed_ratio <= 1.0:
@@ -263,6 +297,25 @@ class Config:
         if self.sync_dtype in ("bfloat16", "int8"):
             return fast
         return fast if backend == "tpu" else "dense"
+
+    def parse_prompt_buckets(self) -> tuple[int, ...]:
+        """``--serve_prompt_buckets`` as ascending unique lengths."""
+        out = []
+        for part in self.serve_prompt_buckets.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            try:
+                out.append(int(part))
+            except ValueError:
+                raise ValueError(
+                    f"serve_prompt_buckets must be comma-separated "
+                    f"integers, got {self.serve_prompt_buckets!r}") from None
+        if not out or min(out) < 1:
+            raise ValueError(
+                f"serve_prompt_buckets needs at least one positive "
+                f"length, got {self.serve_prompt_buckets!r}")
+        return tuple(sorted(set(out)))
 
     def mesh_axes(self) -> dict[str, int]:
         """Parse ``mesh_shape`` into an ordered {axis: size} dict.
@@ -439,6 +492,34 @@ def build_argparser() -> argparse.ArgumentParser:
                         "aggregation)")
     p.add_argument("--sync_bucket_mb", type=float, default=d.sync_bucket_mb,
                    help="sharded-sync bucket size in MiB per collective")
+    p.add_argument("--serve_max_batch", type=int, default=d.serve_max_batch,
+                   help="serve: concurrent decode slots (the one fixed "
+                        "shape the decode-step program compiles at)")
+    p.add_argument("--serve_page_size", type=int, default=d.serve_page_size,
+                   help="serve: tokens per KV-cache page")
+    p.add_argument("--serve_max_pages", type=int, default=d.serve_max_pages,
+                   help="serve: KV-cache page-pool size (page 0 is the "
+                        "reserved trash page)")
+    p.add_argument("--serve_prompt_buckets", type=str,
+                   default=d.serve_prompt_buckets,
+                   help="serve: comma-separated prefill prompt-length "
+                        "buckets; one prefill program compiles per bucket")
+    p.add_argument("--serve_eos_id", type=int, default=d.serve_eos_id,
+                   help="serve: sampling this token id finishes a "
+                        "request (-1 = generate to max_new_tokens)")
+    p.add_argument("--serve_max_new_tokens", type=int,
+                   default=d.serve_max_new_tokens,
+                   help="serve: per-request generation budget")
+    p.add_argument("--serve_temperature", type=float,
+                   default=d.serve_temperature,
+                   help="serve: sampling temperature (0 = greedy)")
+    p.add_argument("--serve_requests", type=int, default=d.serve_requests,
+                   help="serve: synthetic request count when no "
+                        "--serve_prompt is given")
+    p.add_argument("--serve_prompt", type=str, default=d.serve_prompt,
+                   help="serve: fixed prompt as comma-separated token ids "
+                        "(every request decodes it; '' = synthetic "
+                        "per-request prompts)")
     p.add_argument("--sanitize", action="store_true", default=d.sanitize,
                    help="arm the round-loop sanitizer: transfer guard "
                         "around dispatch/wait (implicit transfers raise), "

@@ -113,6 +113,22 @@ def build_model_for(cfg: Config, num_classes: int, **extra):
     return get_model(cfg.model, num_classes=num_classes, dtype=dtype, **extra)
 
 
+def checkpoint_metadata(cfg: Config, num_classes: int,
+                        scan_layers: bool) -> dict:
+    """The arch facts MANIFEST.json carries so ``serve`` (and future
+    inspection tools) rebuild the trained model straight from a checkpoint
+    directory instead of the user restating ``--model``/layer flags
+    (ISSUE 7 satellite).  Keys consumed by
+    ``serve.engine.model_from_metadata``."""
+    return {"model": cfg.model, "num_classes": int(num_classes),
+            "scan_layers": bool(scan_layers),
+            "compute_dtype": cfg.compute_dtype,
+            "num_kv_heads": int(cfg.num_kv_heads),
+            "num_experts": int(cfg.num_experts),
+            "capacity_factor": float(cfg.expert_capacity_factor),
+            "dataset": cfg.dataset}
+
+
 @contextmanager
 def _round_guard(san: dict):
     """Transfer guard around one round's dispatch/wait (ISSUE 6).
@@ -548,7 +564,8 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     if cfg.checkpoint_dir:
         ckpt_engine = ckpt_lib.CheckpointEngine(
             cfg.checkpoint_dir, keep=cfg.ckpt_keep,
-            async_write=cfg.ckpt_async)
+            async_write=cfg.ckpt_async,
+            metadata=checkpoint_metadata(cfg, num_classes, layer_scan_on))
     start_epoch = 0
     if ckpt_engine is not None and cfg.resume:
         latest = ckpt_engine.latest_checkpoint()

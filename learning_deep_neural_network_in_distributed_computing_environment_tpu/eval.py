@@ -54,10 +54,10 @@ def evaluate(model, variables, images: np.ndarray, labels: np.ndarray,
     Returns (loss, accuracy, all_preds, all_labels, metrics_dict).
     Batching pads the tail batch and masks it out (static shapes for jit).
     """
-    from .data.partition import pack_shard
+    from .utils.batching import pad_to_batches
     n = len(labels)
-    steps = int(np.ceil(n / batch_size))
-    x, y, m = pack_shard(images, labels, np.arange(n), batch_size, steps)
+    x, y, m = pad_to_batches(images, labels, batch_size)
+    steps = len(m)
 
     # one-shot per evaluation: the whole test pass is ONE compiled scan
     # closing over this call's (model, variables) — a shared cache entry

@@ -19,6 +19,14 @@ import sys
 
 
 def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "serve":
+        # `main.py serve ...` — continuous-batching inference off a
+        # sharded checkpoint (ISSUE 7); the --serve_* flag group and
+        # --checkpoint_dir configure it, the model itself comes from the
+        # checkpoint's MANIFEST metadata
+        from .serve.api import serve_main
+        return serve_main(argv[1:])
     from .config import config_from_args
     cfg = config_from_args(argv)
     logging.basicConfig(

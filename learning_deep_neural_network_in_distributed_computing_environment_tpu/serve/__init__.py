@@ -1,0 +1,28 @@
+"""Serving engine (ISSUE 7): continuous-batching inference off the
+sharded checkpoints.
+
+Four modules, host policy separated from device programs:
+
+- ``engine``    — ``ServeEngine``: the two compiled programs (per-bucket
+  prefill + one fixed-batch decode step) over the paged KV cache, and
+  ``from_checkpoint``: direct-to-device loading of the PR 5 sharded
+  layout (worker-0 params row, leaf-streamed, no host full-gather).
+- ``cache``     — host-side page bookkeeping: free-list ``PageAllocator``
+  (page 0 reserved as the trash page), page-table rows, byte-exact
+  occupancy accounting.
+- ``scheduler`` — ``ContinuousBatchingScheduler``: admit/evict per decode
+  step, all-or-nothing page claims, EOS/budget stops, telemetry.
+- ``api``       — the driver surface: ``main.py serve`` / ``run_serve``
+  with the serve twin of the sanitizer retrace budget.
+
+The device-side decode math (paged attention, cache-offset causal mask,
+slot/batch-independent sampling keys) lives in ``models/decode.py`` next
+to the training forwards it mirrors.
+"""
+
+from .cache import PageAllocator, page_table_row, pages_needed
+from .engine import ServeEngine
+from .scheduler import Completion, ContinuousBatchingScheduler, Request
+
+__all__ = ["ServeEngine", "ContinuousBatchingScheduler", "Request",
+           "Completion", "PageAllocator", "page_table_row", "pages_needed"]

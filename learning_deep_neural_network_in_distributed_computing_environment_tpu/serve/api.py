@@ -1,0 +1,198 @@
+"""Driver surface for the serving engine: ``main.py serve`` / ``run_serve``.
+
+Self-configures the model from the checkpoint manifest metadata (the
+ISSUE 7 checkpoint satellite): the user points at ``--checkpoint_dir``
+and the ``--serve_*`` group; restating ``--model`` is optional and
+cross-checked (mismatch is a hard error, not a silent override).
+
+``--sanitize`` arms the serving twin of the round-loop retrace budget:
+after a one-request warmup has compiled the prefill buckets + decode
+step, the measured run must add ZERO jaxpr traces / backend compiles —
+the continuous-batching loop re-dispatches two fixed programs, nothing
+else.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+from typing import Any, Optional
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+def build_requests(cfg, vocab: int) -> list:
+    """Requests from the CLI surface: ``--serve_prompt`` (comma-separated
+    token ids, replicated ``--serve_requests`` times) or per-request
+    synthetic prompts drawn from the served vocabulary."""
+    from .scheduler import Request
+    n = max(1, int(cfg.serve_requests))
+    rng = np.random.default_rng(cfg.seed)
+    out = []
+    for i in range(n):
+        if cfg.serve_prompt:
+            ids = [int(t) for t in cfg.serve_prompt.split(",") if t.strip()]
+        else:
+            lo = min(4, cfg.parse_prompt_buckets()[0])
+            plen = int(rng.integers(lo, cfg.parse_prompt_buckets()[0] + 1))
+            ids = rng.integers(0, vocab, plen).tolist()
+        out.append(Request(rid=i, prompt=ids,
+                           max_new_tokens=cfg.serve_max_new_tokens,
+                           temperature=cfg.serve_temperature))
+    return out
+
+
+def run_serve(cfg, requests: Optional[list] = None, *,
+              model_flag_given: Optional[bool] = None) -> dict[str, Any]:
+    """Load the checkpoint onto the serving mesh and serve ``requests``
+    (built from the config when None).  Returns ``{"serve": telemetry,
+    "completions": [...], "engine": ServeEngine}``.
+
+    ``model_flag_given`` — whether the user EXPLICITLY passed ``--model``
+    (``serve_main`` inspects argv; library callers default to "given iff
+    not the dataclass default").  Explicit + metadata mismatch is a hard
+    error; explicit + a metadata-less (pre-metadata) checkpoint is the
+    supported fallback — the arch rebuilds from the registry name with
+    num_classes recovered from the manifest leaf shapes."""
+    import jax
+
+    from .. import checkpoint as ckpt_lib
+    from .engine import ServeEngine, manifest_num_classes
+    from .scheduler import ContinuousBatchingScheduler
+
+    if not cfg.checkpoint_dir:
+        raise ValueError("serve needs --checkpoint_dir (the sharded "
+                         "checkpoint to load)")
+    path = cfg.checkpoint_dir
+    if not os.path.isfile(os.path.join(path, ckpt_lib.MANIFEST)):
+        resolved = ckpt_lib.latest_checkpoint(path)
+        if resolved is None:
+            raise FileNotFoundError(
+                f"no committed checkpoint under {cfg.checkpoint_dir}")
+        path = resolved
+    meta = ckpt_lib.manifest_metadata(path) or {}
+    if model_flag_given is None:
+        # compare against the dataclass default, not a hardcoded name —
+        # one source of truth if the Config default ever changes
+        import dataclasses
+        default_model = next(f.default
+                             for f in dataclasses.fields(type(cfg))
+                             if f.name == "model")
+        model_flag_given = cfg.model != default_model
+    if model_flag_given and meta.get("model") and cfg.model != meta["model"]:
+        raise ValueError(
+            f"--model {cfg.model} does not match the checkpoint's "
+            f"recorded model {meta['model']!r} ({path}); drop --model — "
+            "serve self-configures from the manifest metadata")
+    model = None
+    if not meta:
+        if not model_flag_given:
+            raise ValueError(
+                f"checkpoint {path} carries no serve metadata (saved by "
+                "a pre-metadata engine?) — restate --model gpt_*/llama_* "
+                "to serve it")
+        ncls = manifest_num_classes(path)
+        if ncls is None:
+            raise ValueError(
+                f"checkpoint {path} has no tok_emb params leaf — not an "
+                "autoregressive-family checkpoint, nothing to serve")
+        from ..models import get_model
+        kw: dict[str, Any] = dict(num_classes=ncls, scan_layers=True)
+        if cfg.num_kv_heads:
+            kw["num_kv_heads"] = cfg.num_kv_heads
+        if cfg.num_experts:
+            kw["num_experts"] = cfg.num_experts
+            kw["capacity_factor"] = cfg.expert_capacity_factor
+        model = get_model(cfg.model, **kw)
+        log.info("serve: no manifest metadata; rebuilt %s (vocab %d from "
+                 "manifest leaf shapes)", cfg.model, ncls)
+    buckets = cfg.parse_prompt_buckets()
+    engine = ServeEngine.from_checkpoint(
+        path, model=model, max_batch=cfg.serve_max_batch,
+        page_size=cfg.serve_page_size, max_pages=cfg.serve_max_pages,
+        prompt_buckets=buckets,
+        max_seq=buckets[-1] + cfg.serve_max_new_tokens,
+        seed=cfg.seed)
+    if requests is None:
+        requests = build_requests(cfg, engine.spec.vocab)
+
+    sanitize = cfg.sanitize or (
+        os.environ.get("JAX_GRAFT_SANITIZE", "").strip().lower()
+        not in ("", "0", "false", "off", "no"))
+    counter_ok = False
+    warmup_counts = None
+    if sanitize:
+        from ..xla_flags import (compile_event_counts,
+                                 install_compile_counter)
+        counter_ok = install_compile_counter()
+        if counter_ok:
+            # warmup: ONE request per distinct prefill bucket compiles
+            # every program the workload uses (+ the shared decode step)
+            # off the measured run — warming all N requests would scale
+            # startup with N for no extra compile coverage
+            from ..utils.batching import pick_bucket
+            from .scheduler import Request
+            per_bucket = {}
+            for r in requests:
+                per_bucket.setdefault(
+                    pick_bucket(len(r.prompt), engine.prompt_buckets), r)
+            warm = [Request(rid=10_000_000 + i, prompt=r.prompt,
+                            max_new_tokens=min(2, r.max_new_tokens),
+                            temperature=r.temperature)
+                    for i, r in enumerate(per_bucket.values())]
+            ContinuousBatchingScheduler(
+                engine, eos_id=cfg.serve_eos_id).run(warm)
+            warmup_counts = compile_event_counts()
+
+    sched = ContinuousBatchingScheduler(engine, eos_id=cfg.serve_eos_id)
+    telemetry = sched.run(requests)
+    completions = telemetry.pop("completions")
+    telemetry["retrace_count"] = 0
+    telemetry["recompile_count"] = 0
+    telemetry["sanitized"] = bool(sanitize and counter_ok)
+    if sanitize and counter_ok:
+        from ..xla_flags import compile_event_counts
+        counts = compile_event_counts()
+        telemetry["retrace_count"] = (counts["traces"]
+                                      - warmup_counts["traces"])
+        telemetry["recompile_count"] = (counts["compiles"]
+                                        - warmup_counts["compiles"])
+        if telemetry["retrace_count"] or telemetry["recompile_count"]:
+            raise RuntimeError(
+                f"serve sanitizer: the steady-state decode run added "
+                f"{telemetry['retrace_count']} trace(s) / "
+                f"{telemetry['recompile_count']} compile(s) past the "
+                "warmup — the loop must re-dispatch only the prefill-"
+                "bucket and decode-step programs")
+        log.info("serve sanitizer clean: 0 post-warmup retraces across "
+                 "%d decode steps", telemetry["decode_steps"])
+    return {"serve": telemetry, "completions": completions,
+            "engine": engine}
+
+
+def serve_main(argv=None) -> int:
+    """``python -m ...main serve`` entry: serve off a checkpoint, print
+    one JSON telemetry line plus per-request decoded ids."""
+    from ..config import config_from_args
+    args = sys.argv[1:] if argv is None else list(argv)
+    cfg = config_from_args(args)
+    logging.basicConfig(
+        level=getattr(logging, cfg.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s")
+    # explicit --model (even restating the dataclass default) engages the
+    # mismatch check / metadata-less fallback; absent means self-configure
+    given = any(a == "--model" or a.startswith("--model=") for a in args)
+    results = run_serve(cfg, model_flag_given=given)
+    for c in results["completions"]:
+        print(f"request {c.rid}: prompt_len={c.prompt_len} "
+              f"reason={c.reason} tokens={','.join(map(str, c.tokens))}")
+    print("SERVE " + json.dumps(results["serve"]), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(serve_main())

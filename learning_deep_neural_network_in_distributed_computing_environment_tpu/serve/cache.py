@@ -1,0 +1,76 @@
+"""Page pool bookkeeping for the serving engine's paged KV cache.
+
+The device-side cache layout and attention live in ``models/decode.py``;
+this module is the HOST side: which pages belong to which sequence, and
+the byte-exact occupancy accounting the telemetry/bench gate on.  Page id
+0 is the trash page (``models.decode.TRASH_PAGE``): masked writes from
+prefill padding and inactive decode slots land there, so the allocator
+never hands it out.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..models.decode import TRASH_PAGE
+
+
+def pages_needed(total_tokens: int, page_size: int) -> int:
+    """Pages a sequence reaching ``total_tokens`` positions needs."""
+    return max(1, -(-int(total_tokens) // int(page_size)))
+
+
+class PageAllocator:
+    """Free-list allocator over the page pool (page 0 reserved).
+
+    Allocation is all-or-nothing per request: a sequence gets every page
+    its ``prompt + max_new_tokens`` span can reach up front, so a running
+    decode can never die mid-generation from pool exhaustion — admission
+    is the only place that blocks.  Freed ids return to the HEAD of the
+    free list, so the recycle tests can assert an evicted sequence's
+    pages are literally the next ones handed out."""
+
+    def __init__(self, max_pages: int):
+        if max_pages < 2:
+            raise ValueError(
+                f"max_pages must be >= 2 (page {TRASH_PAGE} is the "
+                f"reserved trash page), got {max_pages}")
+        self.max_pages = int(max_pages)
+        self._free = list(range(1, self.max_pages))
+        self.peak_in_use = 0
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def in_use(self) -> int:
+        return (self.max_pages - 1) - len(self._free)
+
+    def alloc(self, count: int) -> list[int] | None:
+        """``count`` page ids, or None when the pool cannot cover them
+        (the caller keeps the request queued — admission backpressure)."""
+        if count > len(self._free):
+            return None
+        got, self._free = self._free[:count], self._free[count:]
+        self.peak_in_use = max(self.peak_in_use, self.in_use)
+        return got
+
+    def free(self, pages: list[int]) -> None:
+        for p in pages:
+            if p == TRASH_PAGE or p >= self.max_pages:
+                raise ValueError(f"freeing invalid page id {p}")
+            if p in self._free:
+                raise ValueError(f"double free of page {p}")
+        self._free = list(pages) + self._free
+
+
+def page_table_row(pages: list[int], pages_per_seq: int) -> np.ndarray:
+    """A sequence's page-table row: its pages in position order, the
+    unreachable tail pointed at the trash page."""
+    if len(pages) > pages_per_seq:
+        raise ValueError(
+            f"{len(pages)} pages exceed the table width {pages_per_seq}")
+    row = np.full(pages_per_seq, TRASH_PAGE, np.int32)
+    row[:len(pages)] = pages
+    return row

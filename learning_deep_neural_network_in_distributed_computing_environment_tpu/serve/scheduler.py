@@ -1,0 +1,268 @@
+"""Continuous-batching scheduler: the serving control loop.
+
+Every loop iteration is one decode step of the whole engine batch:
+
+1. **admit** — arrived requests claim free decode slots in order; each
+   gets its WHOLE page span (``ceil((prompt + max_new) / page_size)``
+   pages) up front, runs its bucket's prefill program, and samples its
+   first token.  When the pool or the slots are exhausted the head
+   request waits (``admission_blocked`` counts the backpressure) — a
+   running decode can never die from page exhaustion.
+2. **decode** — ONE call of the fixed-shape decode program advances every
+   active slot a token; free slots ride along masked (their writes go to
+   the trash page).
+3. **evict** — slots whose new token is ``eos_id`` or whose budget is
+   spent return their pages to the allocator head (the recycle the tests
+   assert) and free the slot for the next admission.
+
+Sampling keys derive from (seed, request id, position) only — slot and
+batch-composition independent — so a request decodes the identical token
+stream whether it ran alone or packed with others (the
+batched-vs-single gate).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from .engine import ServeEngine
+
+
+@dataclasses.dataclass
+class Request:
+    rid: int
+    prompt: list
+    max_new_tokens: int = 16
+    temperature: float = 0.0
+    arrival_s: float = 0.0        # offset from scheduler start (0 = now)
+
+
+@dataclasses.dataclass
+class Completion:
+    rid: int
+    prompt_len: int
+    tokens: list                  # generated ids (incl. the eos, if hit)
+    reason: str                   # "eos" | "length"
+    token_latencies_s: list       # arrival->first, then inter-token gaps
+
+
+@dataclasses.dataclass
+class _Slot:
+    rid: int
+    pages: list
+    row: np.ndarray               # page-table row [pages_per_seq]
+    length: int                   # tokens in cache
+    temperature: float
+    max_new: int
+    generated: list
+    latencies: list
+    t_last: float
+
+
+class ContinuousBatchingScheduler:
+    """Drives one ``ServeEngine``.  ``max_active`` caps concurrently
+    decoding slots below ``engine.max_batch`` — ``max_active=1`` is the
+    naive sequential-request baseline the bench A/Bs against."""
+
+    def __init__(self, engine: ServeEngine, *, eos_id: int = -1,
+                 max_active: Optional[int] = None):
+        self.engine = engine
+        self.eos_id = int(eos_id)
+        self.max_active = min(int(max_active or engine.max_batch),
+                              engine.max_batch)
+        self.stats = {"admitted": 0, "evicted": 0, "admission_blocked": 0,
+                      "decode_steps": 0, "tokens_generated": 0}
+        self._occupancy: list[int] = []
+
+    # -- request validation (fail at submit, not mid-run) ---------------
+    def _validate(self, r: Request) -> None:
+        eng = self.engine
+        plen = len(r.prompt)
+        if plen < 1 or r.max_new_tokens < 1:
+            raise ValueError(f"request {r.rid}: prompt and max_new_tokens "
+                             "must be non-empty/positive")
+        ids = np.asarray(r.prompt)
+        if ids.min() < 0 or ids.max() >= eng.spec.vocab:
+            # jnp gather would silently clamp/wrap out-of-range ids into
+            # a confidently-wrong decode — fail at submit instead
+            raise ValueError(
+                f"request {r.rid}: prompt ids must lie in "
+                f"[0, {eng.spec.vocab}); got range "
+                f"[{int(ids.min())}, {int(ids.max())}]")
+        if plen > eng.prompt_buckets[-1]:
+            raise ValueError(
+                f"request {r.rid}: prompt length {plen} exceeds the "
+                f"largest prefill bucket {eng.prompt_buckets[-1]}")
+        total = plen + r.max_new_tokens
+        if total > eng.max_seq:
+            raise ValueError(
+                f"request {r.rid}: prompt + max_new ({total}) exceeds "
+                f"max_seq {eng.max_seq}")
+        if eng.pages_for(total) > eng.allocator.max_pages - 1:
+            raise ValueError(
+                f"request {r.rid}: needs {eng.pages_for(total)} pages but "
+                f"the pool holds {eng.allocator.max_pages - 1} — raise "
+                "--serve_max_pages or lower max_new_tokens")
+
+    # -- one admission attempt ------------------------------------------
+    def _admit(self, r: Request, slots: list, t0: float) -> bool:
+        eng = self.engine
+        free_slot = next((i for i, s in enumerate(slots) if s is None),
+                         None)
+        if (free_slot is None
+                or sum(s is not None for s in slots) >= self.max_active):
+            return False
+        pages = eng.allocator.alloc(
+            eng.pages_for(len(r.prompt) + r.max_new_tokens))
+        if pages is None:
+            self.stats["admission_blocked"] += 1
+            return False
+        row = eng.table_row(pages)
+        first, _ = eng.prefill(r.prompt, row, r.temperature, r.rid)
+        now = time.perf_counter()
+        slot = _Slot(rid=r.rid, pages=pages, row=row,
+                     length=len(r.prompt), temperature=r.temperature,
+                     max_new=r.max_new_tokens, generated=[first],
+                     latencies=[now - (t0 + r.arrival_s)], t_last=now)
+        slots[free_slot] = slot
+        self.stats["admitted"] += 1
+        self.stats["tokens_generated"] += 1
+        self._occupancy.append(eng.allocator.in_use)
+        return True
+
+    def _finish(self, slot: _Slot, reason: str) -> Completion:
+        self.engine.allocator.free(slot.pages)
+        self.stats["evicted"] += 1
+        return Completion(rid=slot.rid,
+                          prompt_len=slot.length - len(slot.generated) + 1,
+                          tokens=slot.generated, reason=reason,
+                          token_latencies_s=slot.latencies)
+
+    def _stop_reason(self, slot: _Slot) -> Optional[str]:
+        if self.eos_id >= 0 and slot.generated[-1] == self.eos_id:
+            return "eos"
+        if len(slot.generated) >= slot.max_new:
+            return "length"
+        return None
+
+    # -- the loop --------------------------------------------------------
+    def run(self, requests: list[Request]) -> dict:
+        """Serve ``requests`` to completion; returns the telemetry dict
+        (the ``results["serve"]`` payload) with ``completions`` attached
+        in request order."""
+        eng = self.engine
+        rids = [r.rid for r in requests]
+        if len(set(rids)) != len(rids):
+            # rids key slot lookup, eviction, and the completions dict —
+            # a duplicate would silently cross-wire two requests
+            raise ValueError(
+                f"request ids must be unique, got duplicates in {rids}")
+        for r in requests:
+            self._validate(r)
+        queue = deque(sorted(requests, key=lambda r: (r.arrival_s, r.rid)))
+        slots: list[Optional[_Slot]] = [None] * eng.max_batch
+        done: dict[int, Completion] = {}
+        t0 = time.perf_counter()
+        while queue or any(s is not None for s in slots):
+            now = time.perf_counter() - t0
+            # admit every due request a slot + pages can take, in order
+            while queue and queue[0].arrival_s <= now:
+                if not self._admit(queue[0], slots, t0):
+                    break
+                r = queue.popleft()
+                slot = next(s for s in slots if s is not None
+                            and s.rid == r.rid)
+                reason = self._stop_reason(slot)
+                if reason:   # eos on the very first token / max_new == 1
+                    done[slot.rid] = self._finish(slot, reason)
+                    slots[slots.index(slot)] = None
+            active_idx = [i for i, s in enumerate(slots) if s is not None]
+            if not active_idx:
+                if queue:
+                    # waiting on a future arrival (pages/slots cannot be
+                    # the blocker with nothing active — the pool is empty)
+                    time.sleep(max(0.0, min(
+                        0.001, queue[0].arrival_s - now)))
+                continue
+            b = eng.max_batch
+            tokens = np.zeros(b, np.int32)
+            lengths = np.zeros(b, np.int32)
+            table = np.zeros((b, eng.pages_per_seq), np.int32)
+            temps = np.zeros(b, np.float32)
+            rids = np.zeros(b, np.int32)
+            active = np.zeros(b, bool)
+            for i in active_idx:
+                s = slots[i]
+                tokens[i] = s.generated[-1]
+                lengths[i] = s.length
+                table[i] = s.row
+                temps[i] = s.temperature
+                rids[i] = s.rid
+                active[i] = True
+            nxt, _logits = eng.decode(tokens, lengths, table, temps,
+                                      rids, active)
+            self.stats["decode_steps"] += 1
+            t_now = time.perf_counter()
+            for i in active_idx:
+                s = slots[i]
+                s.length += 1
+                s.generated.append(int(nxt[i]))
+                s.latencies.append(t_now - s.t_last)
+                s.t_last = t_now
+                self.stats["tokens_generated"] += 1
+                reason = self._stop_reason(s)
+                if reason:
+                    done[s.rid] = self._finish(s, reason)
+                    slots[i] = None
+            self._occupancy.append(eng.allocator.in_use)
+        wall = time.perf_counter() - t0
+        return self._telemetry(requests, done, wall)
+
+    # -- telemetry -------------------------------------------------------
+    def _telemetry(self, requests, done: dict, wall: float) -> dict:
+        eng = self.engine
+        lat = [l for c in done.values() for l in c.token_latencies_s]
+        lat_ms = sorted(1e3 * x for x in lat)
+
+        def pct(p):
+            if not lat_ms:
+                return None
+            return round(lat_ms[min(len(lat_ms) - 1,
+                                    int(p / 100.0 * len(lat_ms)))], 3)
+
+        occ = self._occupancy or [0]
+        page_bytes = eng.page_bytes()
+        out = {
+            "enabled": True,
+            "requests": len(requests),
+            "admitted": self.stats["admitted"],
+            "evicted": self.stats["evicted"],
+            "admission_blocked": self.stats["admission_blocked"],
+            "decode_steps": self.stats["decode_steps"],
+            "tokens_generated": self.stats["tokens_generated"],
+            "wall_s": round(wall, 4),
+            "tokens_per_s": round(
+                self.stats["tokens_generated"] / max(wall, 1e-9), 2),
+            "prefill_buckets": sorted(eng.compiled_buckets),
+            "max_batch": eng.max_batch,
+            "latency_ms": {"p50": pct(50), "p99": pct(99),
+                           "mean": (round(float(np.mean(lat_ms)), 3)
+                                    if lat_ms else None)},
+            # byte-exact page accounting: in_use sampled after every
+            # admission/step x the per-page pin across both pools
+            "pages": {"page_size": eng.page_size,
+                      "max_pages": eng.allocator.max_pages,
+                      "page_bytes": page_bytes,
+                      "peak_in_use": max(occ),
+                      "mean_in_use": round(float(np.mean(occ)), 2),
+                      "peak_bytes": max(occ) * page_bytes,
+                      "leaked": eng.allocator.in_use},
+        }
+        out["completions"] = [done[r.rid] for r in requests
+                              if r.rid in done]
+        return out

@@ -1,0 +1,72 @@
+"""Static-shape batching helpers shared by eval and the serving engine.
+
+jit'd programs need fixed shapes, so ragged inputs pad up to a static
+grid and a mask (or a valid-count) carries the real extent:
+
+- ``pad_to_batches`` — the final-eval pad-to-batch + mask logic that
+  used to live inline in ``eval.py`` (via ``data.partition.pack_shard``):
+  the last ragged batch pads by repeating the final real example and the
+  mask zeroes its loss/metric/pred contributions, so tail examples can't
+  skew metrics or logits (ISSUE 7 satellite).
+- ``pick_bucket`` / ``pad_to_bucket`` — prompt-length bucketing for the
+  serve prefill programs: a prompt compiles against the smallest
+  covering bucket instead of its exact length, so the engine holds one
+  compiled prefill per bucket, not per prompt length.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+
+def pad_to_batches(x: np.ndarray, y: np.ndarray, batch_size: int
+                   ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pad ``(x, y)`` of n examples up to whole ``batch_size`` batches.
+
+    Returns ``(x [steps, B, ...], y [steps, B, ...], mask [steps, B])``
+    with mask 0.0 on padding rows.  Padding repeats the last real example
+    (values stay in-domain for embedding lookups); the mask is the
+    correctness boundary — consumers must weight per-example stats by it
+    and slice predictions back to n.
+    """
+    n = len(y)
+    if n == 0 or batch_size < 1:
+        raise ValueError(
+            f"pad_to_batches needs n >= 1 examples and batch_size >= 1, "
+            f"got n={n}, batch_size={batch_size}")
+    steps = -(-n // batch_size)
+    total = steps * batch_size
+    take = np.minimum(np.arange(total), n - 1)
+    mask = (np.arange(total) < n).astype(np.float32)
+    xs = np.take(x, take, axis=0).reshape(steps, batch_size, *x.shape[1:])
+    ys = np.take(y, take, axis=0).reshape(steps, batch_size, *y.shape[1:])
+    return xs, ys, mask.reshape(steps, batch_size)
+
+
+def pick_bucket(length: int, buckets: Sequence[int]) -> int:
+    """The smallest bucket covering ``length`` (buckets ascending)."""
+    for b in buckets:
+        if length <= b:
+            return int(b)
+    raise ValueError(
+        f"prompt length {length} exceeds the largest bucket "
+        f"{max(buckets)} — extend --serve_prompt_buckets")
+
+
+def pad_to_bucket(ids: np.ndarray, bucket: int, fill: int = 0
+                  ) -> np.ndarray:
+    """``ids [n]`` right-padded with ``fill`` to ``[bucket]`` (int32).
+
+    The serve prefill masks the padding via its valid-count (the padded
+    rows' cache writes route to the trash page), so ``fill`` only needs
+    to be a legal token id."""
+    ids = np.asarray(ids, np.int32)
+    if ids.ndim != 1 or len(ids) > bucket:
+        raise ValueError(
+            f"pad_to_bucket needs a 1-D prompt of <= {bucket} ids, got "
+            f"shape {ids.shape}")
+    out = np.full(bucket, fill, np.int32)
+    out[:len(ids)] = ids
+    return out

@@ -121,6 +121,31 @@ EOF
     echo "ckpt smoke assertions FAILED (rc=$crc)"
     exit "$crc"
   fi
+
+  # seconds-scale serving-engine smoke (ISSUE 7): the --entry serve
+  # continuous-batching vs naive sequential A/B under one Poisson trace
+  # must show >= 1.5x tokens/s and byte-exact page-occupancy accounting
+  # in both arms (peak_bytes == peak pages x the per-page pin).
+  echo "== bench smoke: serving engine entry (CPU) =="
+  SERVE_JSON=$(JAX_PLATFORMS=cpu BENCH_BUDGET_S="${BENCH_BUDGET_S:-240}" \
+    python bench.py --entry serve) || { echo "serve smoke FAILED"; exit 1; }
+  echo "$SERVE_JSON"
+  python - "$SERVE_JSON" <<'EOF'
+import json, sys
+out = json.loads(sys.argv[1])
+if out.get("status") == "budget_backstop":
+    sys.exit(0)  # slow host: the backstop line is the accepted outcome
+assert out["speedup_tokens_per_s"] >= 1.5, out["speedup_tokens_per_s"]
+for arm in ("continuous", "naive"):
+    assert out[arm]["page_accounting_exact"] is True, arm
+    assert out[arm]["pages"]["leaked"] == 0, arm
+print("serve smoke OK")
+EOF
+  src=$?
+  if [ "$src" -ne 0 ]; then
+    echo "serve smoke assertions FAILED (rc=$src)"
+    exit "$src"
+  fi
 fi
 
 # Checkpoint kill-mid-write -> resume smoke (ISSUE 5 satellite): phase A
@@ -229,5 +254,72 @@ if ! grep -q "sanitizer clean" "$SAN_OUT"; then
 fi
 rm -rf "$SAN_DIR"
 echo "sanitize smoke OK"
+
+# Serving smoke (ISSUE 7): train 2 rounds of gpt_tiny with per-round
+# checkpoints, then `main.py serve` decodes a fixed prompt GREEDILY off
+# the committed checkpoint through the real CLI (model self-configured
+# from MANIFEST metadata, params streamed worker-0-row to device) under
+# --sanitize (zero post-warmup retraces across the decode run).  The
+# decoded ids must match the full-forward argmax path computed from the
+# trained state, and a second serve run must reproduce them byte-for-byte.
+echo "== serve smoke (train -> checkpoint -> CLI serve, greedy) =="
+SERVE_DIR=$(mktemp -d)
+JAX_PLATFORMS=cpu python - "$SERVE_DIR" <<'EOF'
+import sys
+import numpy as np
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.train import rank0_variables
+
+d = sys.argv[1]
+cfg = Config(model="gpt_tiny", dataset="synthetic_lm", epochs_global=2,
+             epochs_local=1, batch_size=8, limit_train_samples=64,
+             limit_eval_samples=16, compute_dtype="float32", augment=False,
+             aggregation_by="weights", checkpoint_dir=d,
+             checkpoint_every=1, seed=3)
+res = train_global(cfg, progress=False)
+v = rank0_variables(res["state"])
+ids = [5, 9, 3, 7, 2]
+for _ in range(4):
+    lg = res["model"].apply(v, np.asarray(ids, np.int32)[None], train=False)
+    ids.append(int(np.asarray(lg)[0, -1].argmax()))
+with open(f"{d}/expect.txt", "w") as f:
+    f.write(",".join(map(str, ids[5:])))
+EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "serve smoke train phase FAILED (rc=$rc)"; rm -rf "$SERVE_DIR"; exit 1
+fi
+serve_once() {
+  JAX_PLATFORMS=cpu python -m \
+    learning_deep_neural_network_in_distributed_computing_environment_tpu.main \
+    serve --device cpu --checkpoint_dir "$SERVE_DIR" \
+    --serve_prompt 5,9,3,7,2 --serve_max_new_tokens 4 --serve_requests 2 \
+    --serve_max_batch 2 --serve_page_size 8 --serve_max_pages 16 \
+    --serve_prompt_buckets 8 --sanitize 2>/dev/null
+}
+SERVE_OUT1=$(serve_once) || { echo "serve smoke CLI run 1 FAILED"; rm -rf "$SERVE_DIR"; exit 1; }
+SERVE_OUT2=$(serve_once) || { echo "serve smoke CLI run 2 FAILED"; rm -rf "$SERVE_DIR"; exit 1; }
+python - "$SERVE_DIR" <<EOF
+import json, sys
+expect = open(sys.argv[1] + "/expect.txt").read().strip()
+for out in ('''$SERVE_OUT1''', '''$SERVE_OUT2'''):
+    lines = out.strip().splitlines()
+    toks = [l.rsplit("tokens=", 1)[1] for l in lines if "tokens=" in l]
+    assert toks and all(t == expect for t in toks), (toks, expect)
+    tele = json.loads(next(l for l in lines
+                           if l.startswith("SERVE ")).split(" ", 1)[1])
+    assert tele["sanitized"] is True
+    assert tele["retrace_count"] == 0 and tele["recompile_count"] == 0
+    assert tele["pages"]["leaked"] == 0
+print("serve smoke OK: greedy ids == full-forward argmax, twice,"
+      " 0 post-warmup retraces")
+EOF
+rc=$?
+rm -rf "$SERVE_DIR"
+if [ "$rc" -ne 0 ]; then
+  echo "serve smoke assertions FAILED (rc=$rc)"
+  exit "$rc"
+fi
 
 echo "verify OK"
