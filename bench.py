@@ -888,6 +888,89 @@ def measure_serve() -> dict:
     }
 
 
+def measure_elastic() -> dict:
+    """Membership-change round stall vs a steady-state round (ISSUE 8).
+
+    A/B on the simulated 4-worker CPU driver, mlp/mnist: (a) a
+    steady-state run, (b) the identical run with one scripted mid-run
+    worker kill and one join.  The membership boundary's cost is the
+    per-event reshard stall the driver telemeters (host snapshot +
+    row edit + re-partition + mesh/engine rebuild + restage) PLUS the
+    new round program's sanctioned recompile, visible as the chaos run's
+    extra wall.  Asserting surface: the post-kill trajectory of run (b)
+    bitwise-matches (fp32 list equality) a fresh run started from the
+    captured membership snapshot — the ROADMAP's elastic gate, measured
+    here so the headline carries it on every sweep."""
+    import jax
+    import numpy as np
+
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+
+    # adapt to the host like the other engine entries (tests force an
+    # 8-device CPU topology via conftest; a bare `python bench.py` sees
+    # the real device count).  The kill+join needs a worker to spare AND
+    # a free device position for the joiner while one is down.
+    nw = min(4, len(jax.devices()))
+    if nw < 2:
+        return {"skipped": "needs >= 2 devices for a membership change"}
+    rounds = 6
+    kw = dict(model="mlp", dataset="mnist", epochs_global=rounds,
+              epochs_local=1, batch_size=16, limit_train_samples=400,
+              limit_eval_samples=100, compute_dtype="float32",
+              augment=False, aggregation_by="weights", seed=1,
+              num_workers=nw)
+    probe = np.array([1.0, 1.5, 1.0, 2.0])[:nw]
+    # membership-aware wall vectors: nw workers until the kill@2, nw-1
+    # until the join@4, nw after — pinned so the EMA/partition stream is
+    # deterministic and the A side differs only by the absent events
+    chaos_walls = lambda e: np.ones(nw if e < 2 else
+                                    (nw - 1 if e < 4 else nw))
+    steady_walls = lambda e: np.ones(nw)
+
+    t0 = time.perf_counter()
+    steady = train_global(Config(**kw), progress=False,
+                          simulated_durations=probe,
+                          simulated_round_durations=steady_walls)
+    steady_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    chaos = train_global(Config(**kw, chaos="kill@2:w1,join@4"),
+                         progress=False, simulated_durations=probe,
+                         simulated_round_durations=chaos_walls)
+    chaos_s = time.perf_counter() - t0
+    el = chaos["elastic"]
+    snap = el["snapshots"][0]          # post-kill boundary (round 2)
+    fresh = train_global(Config(**kw, chaos="kill@2:w1,join@4"),
+                         progress=False, simulated_durations=probe,
+                         simulated_round_durations=chaos_walls,
+                         elastic_snapshot=snap)
+    bitwise = all(
+        chaos[k][2:] == fresh[k]
+        for k in ("global_train_losses", "global_val_losses"))
+    # honest per-round denominator: POST-WARMUP rounds only (round 0
+    # carries the round program's trace+compile — seconds on this host —
+    # which would flatter the stall-vs-round ratio), from the run's own
+    # per-round telemetry rather than total wall / rounds
+    def _round_ms(t):
+        return sum(t.get(k, 0.0) for k in
+                   ("stage_ms", "compute_ms", "fetch_ms", "assemble_ms"))
+    steady_round_ms = round(float(np.median(
+        [_round_ms(t) for t in steady["round_timings"][1:]])), 1)
+    return {
+        "n_workers": nw, "rounds": rounds,
+        "events": [e["kind"] for e in el["events"]],
+        "steady_round_ms": steady_round_ms,
+        "reshard_stall_ms": [round(m, 1) for m in el["reshard_ms"]],
+        # reshard stall per event, in steady-round units (the cost of a
+        # membership change vs just running another round)
+        "stall_vs_steady_round": [
+            round(m / steady_round_ms, 2) if steady_round_ms else None
+            for m in el["reshard_ms"]],
+        "run_overhead_s": round(chaos_s - steady_s, 2),
+        "bitwise_tail_from_snapshot": bitwise,
+    }
+
+
 def measure_compile() -> dict:
     """Layer-scan compile-engine A/B (ISSUE 3): trace+compile wall and
     step wall for scanned vs unrolled GPT at several depths, plus the
@@ -1225,6 +1308,7 @@ SHORT = {
     "compile_engine": "compile",
     "ckpt_engine": "ckpt",
     "serve_engine": "serve",
+    "elastic_membership": "elastic",
 }
 
 
@@ -1259,6 +1343,8 @@ def _run_entry(key: str, entry_budget: float | None = None) -> dict:
         return measure_ckpt()
     if key == "serve_engine":
         return measure_serve()
+    if key == "elastic_membership":
+        return measure_elastic()
     for k, name, shape, batch, steps, ncls, tok, _tmo, *extra in LADDER:
         if k == key:
             return measure_model(name, shape, batch, steps, ncls, tok,
@@ -1363,6 +1449,12 @@ def _emit_headline(details: dict, extra: dict) -> None:
                      "st": (e.get("async") or {}).get("stall_ms"),
                      "x": e.get("stall_reduction_x"),
                      "same": 1 if e.get("bitwise_async_eq_blocking")
+                     else 0}
+        elif key == "elastic_membership":
+            d[sk] = {"st": e.get("reshard_stall_ms"),
+                     "rd": e.get("steady_round_ms"),
+                     "x": e.get("stall_vs_steady_round"),
+                     "same": 1 if e.get("bitwise_tail_from_snapshot")
                      else 0}
         elif key == "flash_attention":
             def _flash_cell(r):
@@ -1470,7 +1562,8 @@ def main() -> None:
         # sacrificial ViT tail
         jobs[at:at] = ([("round_gap", 150), ("sync_collectives", 120),
                         ("gossip_collectives", 120), ("compile_engine", 150),
-                        ("ckpt_engine", 120), ("serve_engine", 120)]
+                        ("ckpt_engine", 120), ("serve_engine", 120),
+                        ("elastic_membership", 150)]
                        + [(f"flash:L{L}", t) for L, _b, t in FLASH_POINTS])
     for key, tmo in jobs:
         rem = _remaining()

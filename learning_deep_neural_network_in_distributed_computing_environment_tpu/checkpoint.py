@@ -447,13 +447,18 @@ def _read_manifest(epoch_dir: str) -> Optional[dict]:
 
 def _valid_sharded(epoch_dir: str) -> bool:
     """A sharded epoch is restorable iff its manifest parses and EVERY
-    manifested shard file is present at its manifested size (cheap
-    truncation/loss check; full crc verification happens at restore).
-    Restore merges the pieces into FULL host arrays, so a missing shard
-    is exactly as unrestorable as a truncated one — both must drop the
+    manifested shard file is present at its manifested size AND crc32
+    (ISSUE 8 satellite: size alone let a corrupt-but-right-size shard —
+    bit rot, a torn overwrite — reach ``host_tree``, which then RAISED
+    instead of falling back like the truncation path).  Restore merges
+    the pieces into FULL host arrays, so a missing, truncated, or
+    corrupt shard are all equally unrestorable — each must drop the
     epoch so ``latest_checkpoint`` falls back to an intact one.  (This
-    also means multi-host restore needs a shared filesystem, the layout's
-    documented requirement.)"""
+    also means multi-host restore needs a shared filesystem, the
+    layout's documented requirement.)  Cost: one read of each shard of
+    each locally-manifested epoch per listing — at most ``ckpt_keep``
+    epochs by construction, and listings happen at resume/open, never
+    in the round loop."""
     manifest = _read_manifest(epoch_dir)
     if not manifest or "shards" not in manifest:
         return False
@@ -461,6 +466,20 @@ def _valid_sharded(epoch_dir: str) -> bool:
         path = os.path.join(epoch_dir, fname)
         if (not os.path.isfile(path)
                 or os.path.getsize(path) != int(info["bytes"])):
+            return False
+        try:
+            crc = 0
+            with open(path, "rb") as f:
+                # chunked: peak RAM stays one buffer, not one shard
+                while chunk := f.read(1 << 22):
+                    crc = zlib.crc32(chunk, crc)
+        except OSError:
+            return False
+        if crc != int(info["crc32"]):
+            log.warning(
+                "checkpoint shard %s is corrupt (size matches, crc32 "
+                "does not) — dropping epoch from the restorable set",
+                path)
             return False
     return True
 
@@ -547,6 +566,23 @@ def latest_checkpoint(ckpt_dir: str) -> Optional[str]:
 # Restore
 # ----------------------------------------------------------------------
 
+def manifest_worker_axis(epoch_dir: str) -> Optional[int]:
+    """The worker-stacked leading-axis size a committed sharded epoch was
+    written with — read from MANIFEST leaf shapes alone (no shard I/O).
+    Every ``TrainState`` leaf leads with [n_workers], so the value is
+    well-defined whenever the leaves agree; None for legacy/unreadable
+    layouts or disagreeing shapes (caller falls back to the restore-time
+    shape error)."""
+    manifest = _read_manifest(epoch_dir)
+    if not manifest or not manifest.get("leaves"):
+        return None
+    heads = {tuple(i["shape"])[0] if i["shape"] else None
+             for i in manifest["leaves"].values()}
+    if len(heads) != 1 or None in heads:
+        return None
+    return int(heads.pop())
+
+
 def host_tree(path: str) -> tuple[dict[str, np.ndarray], int]:
     """Template-free inspection load of a SHARDED checkpoint: merge every
     locally-visible shard into ``{leaf key: full host ndarray}`` and
@@ -623,7 +659,15 @@ def restore_checkpoint(path: str, state_template):
 
 def _reshard_leaf(tmpl, val):
     if isinstance(tmpl, jax.Array) and hasattr(tmpl, "sharding"):
-        return jax.device_put(val, tmpl.sharding)
+        # .copy() materializes an XLA-owned buffer: device_put of host
+        # numpy on jax 0.4.x XLA:CPU can ZERO-COPY (the jax.Array aliases
+        # numpy-owned malloc memory), and the round program then DONATES
+        # that buffer — XLA freeing memory it never allocated corrupts
+        # the heap (reproducible segfault: resume + a warm persistent
+        # compile cache shifts allocation timing enough to crash every
+        # run; without the cache it corrupts silently or not at all).
+        return jax.block_until_ready(
+            jax.device_put(val, tmpl.sharding)).copy()
     return val
 
 

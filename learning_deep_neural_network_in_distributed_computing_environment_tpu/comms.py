@@ -60,12 +60,24 @@ GOSSIP_HOPS = {"ring": 1, "double_ring": 2}
 DEFAULT_BUCKET_BYTES = 4 << 20
 
 
+def ring_neighbors(n: int, shift: int = 1) -> list[tuple[int, int]]:
+    """The gossip ring's ppermute permutation for ``n`` workers: rank i
+    sends to ``(i + shift) % n``.  Derived from the AXIS SIZE alone —
+    which is what makes the ring elastic (ISSUE 8): a membership change
+    rebuilds the round program on the resized mesh and this table is
+    re-derived for the new ``n``, so the ring always closes over exactly
+    the live workers and a departed rank can never strand a neighbor
+    waiting on it.  Exposed for the elastic tests/telemetry to assert
+    that property (a valid table is a single cycle covering 0..n-1 when
+    gcd(n, shift) == 1)."""
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
 def _shift(x: jnp.ndarray, n: int, shift: int, axis_name: str) -> jnp.ndarray:
     """Receive the value of ``rank - shift`` (mod n): each rank i sends to
     ``i + shift``, matching the reference's Isend(to rank+1)/Irecv(from
     rank-1) gossip pattern."""
-    perm = [(i, (i + shift) % n) for i in range(n)]
-    return lax.ppermute(x, axis_name, perm)
+    return lax.ppermute(x, axis_name, ring_neighbors(n, shift))
 
 
 def aggregate(tree: PyTree, *, how: str = "equal",

@@ -184,6 +184,30 @@ class Config:
     # donation silently doubles peak memory).  A clean run records all
     # zeros in results["sanitize"].  Also armed by JAX_GRAFT_SANITIZE=1.
     sanitize: bool = False
+    # --- elastic membership + chaos harness (ISSUE 8) ----------------------
+    # chaos: fault-injection plan for the simulated N-worker CPU driver.
+    # Scripted spec — comma-separated `kind@round[:wID][xF][+S][*K]`
+    # events (kill/join/slow/stall, rounds are 0-based global epochs,
+    # membership changes land at the boundary ENTERING that round) — or
+    # the literal "random" (chaos_seed/chaos_events draw the schedule up
+    # front, so checkpoint resume replays it identically).  "" = off.
+    chaos: str = ""
+    chaos_seed: int = 0           # random-mode schedule seed
+    chaos_events: int = 4         # random-mode event count
+    # Straggler departure protocol (retry/timeout/backoff around the
+    # round sync): a worker whose measured round wall exceeds
+    # time_limit + chaos_grace*(1 + chaos_backoff*attempt) has overrun;
+    # up to chaos_retries CONSECUTIVE overruns are tolerated as logged
+    # retries with the backoff-extended deadline, one more and the
+    # worker is treated as DEPARTED — its state row dropped and its
+    # shard redistributed at the next round boundary.
+    chaos_grace: float = 5.0
+    chaos_retries: int = 1
+    chaos_backoff: float = 0.5
+    # Quorum floor: membership events that would leave fewer live
+    # workers are rejected (logged + counted), never partially applied —
+    # the run degrades gracefully to the surviving quorum instead.
+    elastic_min_workers: int = 1
     # --- serving engine (ISSUE 7: `main.py serve`) -------------------------
     # Continuous-batching inference off a sharded checkpoint: the model
     # self-configures from the checkpoint's MANIFEST metadata
@@ -201,6 +225,11 @@ class Config:
     serve_requests: int = 8       # synthetic requests when no prompt given
     serve_prompt: str = ""        # fixed prompt (csv token ids) for all
     #                               requests; "" = per-request synthetic
+    # Per-request wall-clock timeout (seconds; 0 = off): an admitted
+    # sequence still decoding past this budget is EVICTED (reason
+    # "timeout", counted in results["serve"]["timed_out"]) so a stuck
+    # request can never pin decode slots and cache pages forever.
+    serve_request_timeout: float = 0.0
 
     def __post_init__(self) -> None:
         _choices("backend", self.backend, ("jax", "gloo", "nccl", "mpi"))
@@ -266,7 +295,28 @@ class Config:
             raise ValueError(
                 f"serve_temperature must be >= 0 (0 = greedy), got "
                 f"{self.serve_temperature}")
+        if self.serve_request_timeout < 0.0:
+            raise ValueError(
+                f"serve_request_timeout must be >= 0 (0 = off), got "
+                f"{self.serve_request_timeout}")
         self.parse_prompt_buckets()   # validates the csv eagerly
+        if self.chaos and self.chaos.strip().lower() != "random":
+            # eager spec validation, like parse_prompt_buckets: a typo'd
+            # --chaos fails at argparse time, not at round boundary 3
+            from .chaos import parse_chaos_spec
+            parse_chaos_spec(self.chaos)
+        if self.chaos_events < 0 or self.chaos_retries < 0:
+            raise ValueError(
+                f"chaos_events ({self.chaos_events}) and chaos_retries "
+                f"({self.chaos_retries}) must be >= 0")
+        if self.chaos_grace < 0.0 or self.chaos_backoff < 0.0:
+            raise ValueError(
+                f"chaos_grace ({self.chaos_grace}) and chaos_backoff "
+                f"({self.chaos_backoff}) must be >= 0")
+        if self.elastic_min_workers < 1:
+            raise ValueError(
+                f"elastic_min_workers must be >= 1, got "
+                f"{self.elastic_min_workers}")
         if not 0.0 <= self.local_weight <= 1.0:
             raise ValueError(f"local_weight must be in [0,1], got {self.local_weight}")
         if not 0.0 <= self.fixed_ratio <= 1.0:
@@ -520,6 +570,38 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="serve: fixed prompt as comma-separated token ids "
                         "(every request decodes it; '' = synthetic "
                         "per-request prompts)")
+    p.add_argument("--serve_request_timeout", type=float,
+                   default=d.serve_request_timeout,
+                   help="serve: per-request wall-clock budget in seconds "
+                        "— a sequence still decoding past it is evicted "
+                        "(reason 'timeout') instead of pinning its slot "
+                        "and pages forever (0 = off)")
+    # --- chaos / elastic membership group (ISSUE 8) ------------------------
+    p.add_argument("--chaos", type=str, default=d.chaos,
+                   help="fault-injection plan: comma-separated "
+                        "kind@round[:wID][xF][+S][*K] events (kill/join/"
+                        "slow/stall) or 'random' (seeded schedule); "
+                        "membership changes apply at round boundaries "
+                        "via the elastic reshard — no process restart")
+    p.add_argument("--chaos_seed", type=int, default=d.chaos_seed,
+                   help="seed for --chaos random's up-front event draw")
+    p.add_argument("--chaos_events", type=int, default=d.chaos_events,
+                   help="event count for --chaos random")
+    p.add_argument("--chaos_grace", type=float, default=d.chaos_grace,
+                   help="seconds past --time_limit before a round wall "
+                        "counts as a straggler overrun")
+    p.add_argument("--chaos_retries", type=int, default=d.chaos_retries,
+                   help="consecutive straggler overruns tolerated (each "
+                        "a logged retry with a backoff-extended "
+                        "deadline) before the worker is treated as "
+                        "departed and its shard redistributed")
+    p.add_argument("--chaos_backoff", type=float, default=d.chaos_backoff,
+                   help="per-retry grace extension factor: attempt k's "
+                        "deadline is time_limit + grace*(1 + backoff*k)")
+    p.add_argument("--elastic_min_workers", type=int,
+                   default=d.elastic_min_workers,
+                   help="quorum floor: membership events that would drop "
+                        "below this many live workers are rejected")
     p.add_argument("--sanitize", action="store_true", default=d.sanitize,
                    help="arm the round-loop sanitizer: transfer guard "
                         "around dispatch/wait (implicit transfers raise), "

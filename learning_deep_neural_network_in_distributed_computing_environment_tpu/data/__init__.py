@@ -4,6 +4,7 @@ augmentation."""
 
 from .sources import Dataset, load_dataset, train_val_split  # noqa: F401
 from .partition import (  # noqa: F401
+    adaptive_partition,
     budget_from_time_limit,
     contiguous_partition,
     efficiency_ratios,

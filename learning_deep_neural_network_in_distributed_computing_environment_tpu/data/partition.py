@@ -70,6 +70,33 @@ def contiguous_partition(total_size: int, ratios: np.ndarray) -> list[np.ndarray
     return out
 
 
+def adaptive_partition(total_size: int, ratios: np.ndarray, *,
+                       labels: np.ndarray | None = None,
+                       fixed_classes: list | None = None,
+                       fixed_ratio: float = 0.5,
+                       rng: np.random.Generator | None = None
+                       ) -> list[np.ndarray]:
+    """Full adaptive partition draw: proportional contiguous blocks plus
+    the optional non-IID skew overlay — the initial-partition recipe the
+    driver runs at round 0, packaged so a MEMBERSHIP BOUNDARY can re-draw
+    it identically (ISSUE 8: on a worker kill the departed shard
+    redistributes through the survivors' re-drawn shares; on a join the
+    newcomer's share is carved out of everyone's).  ``fixed_classes`` is
+    per-worker (ordered like ``ratios``); skew draws consume ``rng`` in
+    worker order, train set before val set when the caller partitions
+    both."""
+    parts = contiguous_partition(total_size, ratios)
+    if fixed_classes is not None:
+        if labels is None or rng is None:
+            raise ValueError(
+                "disbalanced adaptive_partition needs labels and rng for "
+                "the skew draws")
+        parts = [skew_partition(labels, p, fixed_classes[i], fixed_ratio,
+                                rng)
+                 for i, p in enumerate(parts)]
+    return parts
+
+
 # --------------------------------------------------------------------------
 # Re-partition (balanced)
 # --------------------------------------------------------------------------

@@ -20,6 +20,7 @@ Returns the reference's twelve metric structures under their original names
 
 from __future__ import annotations
 
+import copy
 import logging
 import os
 import sys
@@ -31,18 +32,19 @@ from typing import Any
 import jax
 import numpy as np
 
+from . import chaos as chaos_lib
+from . import elastic as elastic_lib
 from . import probe as probe_lib
 from .config import Config
 from .data import (
+    adaptive_partition,
     budget_from_time_limit,
-    contiguous_partition,
     efficiency_ratios,
     fixed_classes_for_rank,
     load_dataset,
     PackBufferPool,
     pack_window,
     repartition,
-    skew_partition,
     skew_repartition,
     step_budget,
     train_val_split,
@@ -50,7 +52,8 @@ from .data import (
 )
 from . import checkpoint as ckpt_lib
 from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS,
-                   build_mesh, initialize_distributed)
+                   build_mesh, initialize_distributed, max_data_axis_size,
+                   resize_data_axis)
 from .models import get_model, is_attention_model, is_token_model
 from .train import LocalSGDEngine, rank0_variables
 
@@ -61,7 +64,7 @@ def _round_up(x: int, mult: int) -> int:
     return ((x + mult - 1) // mult) * mult if x else mult
 
 
-def _assemble_round_metrics(results: dict, mx: dict, n: int) -> None:
+def _assemble_round_metrics(results: dict, mx: dict, worker_ids) -> None:
     """One round's mx arrays -> the reference metric lists.
 
     Vectorized rewrite of the reference's nested per-epoch/per-worker
@@ -70,12 +73,21 @@ def _assemble_round_metrics(results: dict, mx: dict, n: int) -> None:
     SAME lists in the SAME order — row-major masking of [E, S] is the
     original epoch-major extend order per worker, of [N, S] the original
     worker-major order per epoch.  Runs on the metric worker thread in
-    the overlapped pipeline, inline in serial mode."""
+    the overlapped pipeline, inline in serial mode.
+
+    ``worker_ids`` maps mesh rows to LOGICAL worker ids (ISSUE 8): the
+    per-worker ``all_workers_losses`` lists are keyed by logical id, so
+    a worker's curve stays its own across elastic membership changes (a
+    departed worker's list freezes, a joiner gets a fresh one).  A bare
+    int keeps the pre-elastic call shape (ids 0..n-1)."""
+    if isinstance(worker_ids, (int, np.integer)):
+        worker_ids = list(range(int(worker_ids)))
     bl = np.asarray(mx["batch_losses"])          # [N, E, S]
     valid = np.asarray(mx["batch_mask"]) > 0
     epochs_local = bl.shape[1]
-    for i in range(n):
-        results["all_workers_losses"][i].extend(bl[i][valid[i]].tolist())
+    for pos, wid in enumerate(worker_ids):
+        results["all_workers_losses"][wid].extend(
+            bl[pos][valid[pos]].tolist())
     for e in range(epochs_local):
         results["all_epochs_losses"].append(bl[:, e][valid[:, e]].tolist())
     results["global_epoch_losses"].append(
@@ -184,15 +196,23 @@ def _measured_worker_walls(wall: float, n: int) -> np.ndarray:
 
 def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                  simulated_round_durations=None, datasets=None,
-                 progress: bool = True) -> dict[str, Any]:
+                 elastic_snapshot=None, progress: bool = True
+                 ) -> dict[str, Any]:
     """Run the full experiment; returns the reference's metric structures.
 
     ``simulated_durations``: inject per-worker probe durations (tests /
     heterogeneity experiments on homogeneous hardware).
     ``simulated_round_durations``: callable ``epoch -> [N] seconds``
     overriding the measured round wall time per worker (tests of the
-    mid-run straggler feedback).
+    mid-run straggler feedback).  Under ``--chaos`` the vector length
+    must match the round's CURRENT membership size.
     ``datasets``: optional (train, val, test) ``Dataset`` triple override.
+    ``elastic_snapshot``: a ``MembershipSnapshot`` (from a previous run's
+    ``results["elastic"]["snapshots"]``) to start from — the fresh-run
+    twin of the in-process membership transition, executing the identical
+    staging path (the ISSUE 8 bitwise-trajectory gate).  Skips the probe
+    and initial partition; membership events at rounds <= the snapshot's
+    epoch are already baked into its roster and are not replayed.
     """
     initialize_distributed()
     from .xla_flags import compile_cache_counts, install_cache_counter
@@ -225,11 +245,37 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         if not san_counter_ok:
             log.warning("sanitizer: trace/compile monitoring unavailable "
                         "on this jax — the retrace budget is not enforced")
+    # --- elastic membership + chaos harness (ISSUE 8) ------------------
+    # The chaos schedule is pure data keyed by absolute round index; the
+    # straggler policy (retry/timeout/backoff around the round sync) is
+    # armed exactly when chaos is — a clean production run must never
+    # declare a worker departed because a CI host hiccuped.
+    schedule = chaos_lib.ChaosSchedule.from_config(cfg)
+    if elastic_snapshot is not None and schedule is not None:
+        # the snapshot IS the post-event state: membership events at
+        # rounds <= its epoch are baked into its roster and must not
+        # replay (wall perturbations stay — slow factors persist from
+        # their event round on, exactly as the continued run feels them)
+        schedule = chaos_lib.ChaosSchedule(
+            [e for e in schedule.events
+             if e.kind not in ("kill", "join")
+             or e.round > elastic_snapshot.epoch])
+    policy = (chaos_lib.StragglerPolicy(
+        cfg.time_limit, cfg.chaos_grace, cfg.chaos_retries,
+        cfg.chaos_backoff) if schedule is not None else None)
+    elastic_on = schedule is not None or elastic_snapshot is not None
     if mesh is None:
         axes = cfg.mesh_axes()
         if cfg.num_workers:
             axes[DATA_AXIS] = cfg.num_workers
+        if elastic_snapshot is not None:
+            axes[DATA_AXIS] = elastic_snapshot.n_workers
         mesh = build_mesh(axes)
+    elif (elastic_snapshot is not None
+          and mesh.shape[DATA_AXIS] != elastic_snapshot.n_workers):
+        # the caller's mesh predates the membership change; rebuild the
+        # data axis exactly as the in-process transition does
+        mesh = resize_data_axis(mesh, elastic_snapshot.n_workers)
     n = mesh.shape[DATA_AXIS]
     if jax.process_count() > 1 and n % jax.process_count():
         # validate once at setup: probe-duration and wall-time attribution
@@ -240,7 +286,40 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             f"worker axis ({n}) must be divisible by the process count "
             f"({jax.process_count()}): per-process probe/wall attribution "
             "maps whole worker-row blocks to whole processes")
+    if elastic_on and jax.process_count() > 1:
+        raise NotImplementedError(
+            "elastic membership / --chaos drives the simulated N-worker "
+            "single-process driver; multi-process membership changes need "
+            "a coordinated mesh rebuild across hosts (ROADMAP follow-on)")
     rng = np.random.default_rng(cfg.seed)
+    # logical worker roster: initial workers are 0..N-1, joiners take the
+    # next free ids for the life of the run (never recycled)
+    worker_ids = (list(elastic_snapshot.worker_ids)
+                  if elastic_snapshot is not None else list(range(n)))
+    # the run's ROUND-0 worker count: a fresh twin inherits the original
+    # run's (its own starting roster is the post-change one) so random
+    # wall-fault pinning below — and the snapshots it builds — agree
+    n_round0 = (elastic_snapshot.n_round0
+                if elastic_snapshot is not None
+                and elastic_snapshot.n_round0 else n)
+    if schedule is not None:
+        # covers --num_workers 0 (mesh-derived axis): from_config could
+        # only pin random wall-fault targets when num_workers was
+        # explicit; here the round-0 roster is known (idempotent —
+        # explicit-num_workers runs were pinned identically already)
+        schedule.pin_wall_targets(range(n_round0))
+    plan = elastic_lib.MembershipPlan(
+        n, min_workers=cfg.elastic_min_workers,
+        max_workers=max_data_axis_size(mesh), worker_ids=worker_ids,
+        next_id=(elastic_snapshot.next_worker_id
+                 if elastic_snapshot is not None else None))
+    n_start = n
+    pending_departs: list = []   # straggler-protocol departures awaiting
+    #                              the next round boundary
+    el: dict[str, Any] = {"enabled": elastic_on, "events": [],
+                          "rejected": [], "sync_retries": [],
+                          "reshard_ms": [], "rounds_degraded": 0,
+                          "snapshots": []}
 
     # --- data ---------------------------------------------------------
     if datasets is None:
@@ -554,7 +633,13 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     log.info("round-sync engine: %s (topology=%s, wire=%s)",
              engine.sync_mode, cfg.topology, cfg.sync_dtype)
     sample = trainset.images[:batch]
-    state = engine.init_state(jax.random.key(cfg.seed), sample)
+    if elastic_snapshot is None:
+        state = engine.init_state(jax.random.key(cfg.seed), sample)
+    else:
+        # fresh run from a membership snapshot: the IDENTICAL staging the
+        # in-process continuation performs (elastic.py module docstring —
+        # the shared path is what makes the bitwise gate mechanical)
+        state = engine.stage_state(elastic_snapshot.host_state)
 
     # --- checkpoint engine + resume (beyond-reference; off when no dir) --
     # Opening the engine sweeps stale mid-write leftovers (.tmp files,
@@ -568,36 +653,97 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             metadata=checkpoint_metadata(cfg, num_classes, layer_scan_on))
     start_epoch = 0
     if ckpt_engine is not None and cfg.resume:
+        if elastic_snapshot is not None:
+            raise ValueError(
+                "--resume and elastic_snapshot are mutually exclusive: a "
+                "membership snapshot already fixes the starting state")
         latest = ckpt_engine.latest_checkpoint()
+        if latest and schedule is not None:
+            # checkpoint resume REPLAYS the deterministic chaos schedule
+            # from the checkpoint epoch (the crash-during-reshard recovery
+            # path: an event AT the resume boundary re-applies).  A
+            # membership event at an EARLIER round means the checkpoint
+            # was written on a post-change roster the restore template
+            # cannot represent — refuse with the real reason BEFORE the
+            # restore turns it into a shape-mismatch traceback.
+            # epoch from the already-resolved latest path (ckpt_<E> /
+            # ckpt_<E>.msgpack) — committed_epochs would re-read and
+            # re-crc every shard of every kept epoch a third time
+            resume_epoch = int(os.path.basename(latest)
+                               .removesuffix(".msgpack")
+                               .rsplit("_", 1)[1])
+            past = [e.describe() for e in schedule.events
+                    if e.kind in ("kill", "join")
+                    and e.round < resume_epoch]
+            if past:
+                raise ValueError(
+                    f"cannot resume at epoch {resume_epoch} across "
+                    f"earlier membership events {past}: checkpoint resume "
+                    "replays --chaos from the resume epoch, so membership "
+                    "events must land at rounds >= it")
+            # the schedule scan can't see STRAGGLER-protocol departures
+            # (implicit kills that never appear in --chaos); the manifest
+            # records the worker axis the checkpoint was written with, so
+            # a departure-shrunk checkpoint is refused with the real
+            # reason instead of restore's opaque shape mismatch
+            axis = (ckpt_lib.manifest_worker_axis(latest)
+                    if os.path.isdir(latest) else None)
+            if axis is not None and axis != n:
+                raise ValueError(
+                    f"cannot resume: checkpoint {latest} was written "
+                    f"with {axis} worker(s) but this run starts with "
+                    f"{n} — a membership change (straggler departure "
+                    "or kill/join) happened before it was saved; "
+                    "restart fresh or resume a pre-change epoch")
         if latest:
             state, start_epoch = ckpt_lib.restore_checkpoint(latest, state)
             log.info("resumed from %s at global epoch %d", latest, start_epoch)
 
     # --- probe -> ratios -> initial partition ---------------------------
-    init_vars = rank0_variables(state)
-    durations, sec_per_batch = probe_lib.estimate_epoch_duration(
-        model, init_vars, sample, n, cfg.probe_batches, simulated_durations)
-    ratios = efficiency_ratios(durations, cfg.proportionality)
-    log.info("probe durations %s -> ratios %s", durations, ratios)
+    if elastic_snapshot is None:
+        init_vars = rank0_variables(state)
+        durations, sec_per_batch = probe_lib.estimate_epoch_duration(
+            model, init_vars, sample, n, cfg.probe_batches,
+            simulated_durations)
+        ratios = efficiency_ratios(durations, cfg.proportionality)
+        log.info("probe durations %s -> ratios %s", durations, ratios)
 
-    train_parts = contiguous_partition(len(trainset), ratios)
-    val_parts = contiguous_partition(len(valset), ratios)
-    fixed_classes = None
-    if cfg.data_mode == "disbalanced":
-        fixed_classes = [fixed_classes_for_rank(r, num_classes)
-                         for r in range(n)]
-        train_parts = [
-            skew_partition(trainset.labels, p, fixed_classes[r],
-                           cfg.fixed_ratio, rng)
-            for r, p in enumerate(train_parts)]
-        val_parts = [
-            skew_partition(valset.labels, p, fixed_classes[r],
-                           cfg.fixed_ratio, rng)
-            for r, p in enumerate(val_parts)]
+        # the SAME recipe (and rng draw order: train before val, workers
+        # in order) elastic.build_snapshot re-draws at a membership
+        # boundary — one implementation, so the fresh-run-vs-snapshot
+        # bitwise gate can never drift out from under an edit here
+        fixed_classes = ([fixed_classes_for_rank(r, num_classes)
+                          for r in range(n)]
+                         if cfg.data_mode == "disbalanced" else None)
+        train_parts = adaptive_partition(
+            len(trainset), ratios, labels=trainset.labels,
+            fixed_classes=fixed_classes, fixed_ratio=cfg.fixed_ratio,
+            rng=rng)
+        val_parts = adaptive_partition(
+            len(valset), ratios, labels=valset.labels,
+            fixed_classes=fixed_classes, fixed_ratio=cfg.fixed_ratio,
+            rng=rng)
+    else:
+        # the snapshot carries the post-event heterogeneity EMA, the
+        # re-drawn partitions, and the RNG stream position — no probe, no
+        # initial partition, no extra draws (bitwise-gate requirement)
+        start_epoch = int(elastic_snapshot.epoch)
+        sec_per_batch = np.asarray(elastic_snapshot.sec_per_batch,
+                                   np.float64).copy()
+        train_parts = [np.asarray(p).copy()
+                       for p in elastic_snapshot.train_parts]
+        val_parts = [np.asarray(p).copy()
+                     for p in elastic_snapshot.val_parts]
+        fixed_classes = copy.deepcopy(elastic_snapshot.fixed_classes)
+        rng.bit_generator.state = copy.deepcopy(elastic_snapshot.rng_state)
+        log.info("continuing from membership snapshot: round %d, "
+                 "workers %s", start_epoch, worker_ids)
 
     # --- reference metric structures (trainer.py:13-25) -----------------
     results: dict[str, Any] = {
-        "all_workers_losses": [[] for _ in range(n)],
+        # keyed by LOGICAL worker id (== mesh row until the first elastic
+        # membership change; a snapshot run's roster may have gaps)
+        "all_workers_losses": [[] for _ in range(max(worker_ids) + 1)],
         "all_epochs_losses": [],
         "global_epoch_losses": [],
         "global_epoch_accuracies": [],
@@ -709,9 +855,13 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     # round's wait outside the transfer guard and its donated buffers
     # unchecked — the sanitizer's contract is every-round coverage, and
     # it is a debugging harness, so determinism beats overlap here.
+    # Chaos runs also force the barrier path: a membership boundary must
+    # find the previous round fully settled (its wall recorded, so the
+    # straggler verdict and the EMA the snapshot captures are final)
+    # before the state is snapshotted and the mesh rebuilt.
     deep_pipeline = (overlap and not streaming
                      and jax.default_backend() != "cpu"
-                     and not sanitize)
+                     and not sanitize and schedule is None)
 
     def build_inputs(tparts, vparts, caps):
         if streaming:
@@ -788,7 +938,7 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                 for i, p in enumerate(val_parts)]
         return make_prep(train_parts, val_parts)
 
-    def report_progress(mx, global_epoch: int, wall: float):
+    def report_progress(mx, global_epoch: int, wall: float, wids):
         if not (progress and jax.process_index() == 0):
             return
         # the reference's per-rank per-local-epoch report lines
@@ -798,13 +948,13 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         # this runs on the metric worker thread (tqdm locks internally).
         say = pbar.write if pbar is not None else print
         epochs_local = np.asarray(mx["train_loss"]).shape[1]
-        for r in range(n):
+        for r, wid in enumerate(wids):
             for e in range(epochs_local):
-                say(f"Rank {r}, Global Epoch {global_epoch + 1}, "
+                say(f"Rank {wid}, Global Epoch {global_epoch + 1}, "
                     f"Local Epoch {e + 1}, "
                     f"Loss: {mx['train_loss'][r, e]}, "
                     f"Accuracy: {mx['train_acc'][r, e]}")
-                say(f"Worker {r}, Global Epoch {global_epoch + 1}, "
+                say(f"Worker {wid}, Global Epoch {global_epoch + 1}, "
                     f"Validation Loss: {mx['val_loss'][r, e]:.4f}, "
                     f"Validation Accuracy: {mx['val_acc'][r, e]:.2f}%")
         if pbar is not None:  # trainer.py:174 postfix
@@ -821,18 +971,22 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                   f"({wall:.1f}s)")
 
     def metrics_job(handle, global_epoch: int, t_dispatch: float,
-                    timing: dict):
+                    timing: dict, wids):
         """Fetch + vectorized assembly of one round's metrics; the
         overlapped pipeline runs this on the worker thread while the next
         round computes (in that mode fetch_ms includes the tail of the
-        round's own device time — it is hidden wall, not host gap)."""
+        round's own device time — it is hidden wall, not host gap).
+        ``wids`` is the round's OWN membership roster, captured at
+        dispatch: a membership change at the next boundary must not
+        re-map this round's rows."""
         t0 = time.perf_counter()
         mx = engine.finish_metrics(handle)
         timing["fetch_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
         t0 = time.perf_counter()
-        _assemble_round_metrics(results, mx, n)
+        _assemble_round_metrics(results, mx, wids)
         timing["assemble_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
-        report_progress(mx, global_epoch, time.perf_counter() - t_dispatch)
+        report_progress(mx, global_epoch, time.perf_counter() - t_dispatch,
+                        wids)
 
     executor = (ThreadPoolExecutor(max_workers=1,
                                    thread_name_prefix="round-metrics")
@@ -858,11 +1012,40 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         if simulated_round_durations is not None:
             worker_walls = np.asarray(
                 simulated_round_durations(ep), np.float64)
+            if worker_walls.shape != (n,):
+                raise ValueError(
+                    f"simulated_round_durations({ep}) returned shape "
+                    f"{worker_walls.shape}; round {ep}'s membership has "
+                    f"{n} workers")
         else:
             # total steps this round = epochs_local x (train + val
             # steps); attribute the wall to train steps proportionally
             worker_walls = _measured_worker_walls(wall, n) / max(
                 cfg.epochs_local, 1)
+        if schedule is not None:
+            # chaos slow/stall faults perturb ONLY this host-side
+            # measured-wall vector (chaos.py) — device numerics are
+            # untouched, which is what keeps chaos runs bit-deterministic
+            worker_walls = schedule.perturb_walls(ep, worker_ids,
+                                                  worker_walls)
+        if policy is not None:
+            # straggler protocol: overruns past the backoff-extended
+            # deadline are tolerated as logged retries; one past the
+            # retry budget and the worker departs at the next boundary,
+            # its shard redistributed to the surviving quorum
+            departed, retries = policy.observe(worker_ids, worker_walls)
+            if retries:
+                el["sync_retries"].extend(retries)
+                for r in retries:
+                    log.warning("elastic: straggler retry %s", r)
+            for wid in departed:
+                log.warning(
+                    "elastic: worker %d overran its straggler budget in "
+                    "round %d (wall past time_limit + extended grace, "
+                    "retries exhausted) — departing at the next round "
+                    "boundary", wid, ep)
+                pending_departs.append(chaos_lib.ChaosEvent(
+                    kind="depart", round=ep + 1, worker=int(wid)))
         walls_by_round[ep] = (worker_walls, steps_run)
 
     def finish_inflight():
@@ -880,6 +1063,126 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         t_done_prev[0] = t_done
         record_walls(ep, t_done - start, steps_, timing_)
 
+    # --- elastic membership transition (ISSUE 8 tentpole) ---------------
+    def install_from_snapshot(snap) -> None:
+        """Adopt a membership snapshot as the live run configuration.
+
+        The mesh's data axis is rebuilt at the new worker count (inner
+        TP/PP/SP/EP axes untouched), a fresh engine re-buckets the sync
+        program and re-derives the gossip ring/double-ring ppermute
+        neighbor tables from the new axis size (a departed worker can
+        never strand the ring), and the row-edited host state restages
+        through ``stage_state`` — the PR 5 cross-mesh reshard, in
+        process.  The fresh-run twin (``elastic_snapshot=``) executes
+        this identical configuration at setup."""
+        nonlocal state, mesh, engine, n, worker_ids, sec_per_batch, \
+            train_parts, val_parts, fixed_classes
+        mesh = resize_data_axis(mesh, snap.n_workers)
+        engine = LocalSGDEngine(model, mesh, cfg, train_model=train_model,
+                                param_specs_fn=param_specs_fn)
+        state = engine.stage_state(snap.host_state)
+        n = snap.n_workers
+        worker_ids = list(snap.worker_ids)
+        sec_per_batch = np.asarray(snap.sec_per_batch, np.float64).copy()
+        train_parts = [np.asarray(p).copy() for p in snap.train_parts]
+        val_parts = [np.asarray(p).copy() for p in snap.val_parts]
+        fixed_classes = copy.deepcopy(snap.fixed_classes)
+        rng.bit_generator.state = copy.deepcopy(snap.rng_state)
+        for wid in worker_ids:   # joiners get fresh per-logical-id lists
+            while len(results["all_workers_losses"]) <= wid:
+                results["all_workers_losses"].append([])
+
+    def membership_boundary(rnd: int) -> None:
+        """Resolve + apply membership events at the boundary entering
+        round ``rnd``: scripted/random chaos kill/join events plus any
+        straggler-protocol departures observed last round.  On a change,
+        capture the full post-event configuration as a
+        ``MembershipSnapshot`` and install it in process — no restart."""
+        nonlocal state, prep, san_warmup
+        events = list(pending_departs)
+        if schedule is not None:
+            events += schedule.membership_events(rnd)
+        if not events:
+            return
+        # settle EVERYTHING in flight first: the transition reads and
+        # retires the whole device state, restructures the per-worker
+        # metric lists the worker thread writes, and replaces the engine
+        finish_inflight()
+        while pending:
+            pending.pop(0).result()
+        change = plan.apply(
+            events, resolve=(schedule.resolve_target
+                             if schedule is not None else None))
+        pending_departs.clear()
+        if change.rejected:
+            # graceful degradation: an event that would sink the roster
+            # below the quorum floor or past device capacity is recorded
+            # and skipped, never partially applied — the surviving quorum
+            # keeps training
+            el["rejected"].extend(change.rejected)
+            for r in change.rejected:
+                log.warning("elastic: membership event rejected: %s", r)
+        if not change.changed:
+            return
+        if sanitize and san_counter_ok and san_warmup is not None:
+            # close THIS steady-state segment's zero-retrace budget the
+            # moment a change is committed, BEFORE any transition work:
+            # checkpoint_fence and build_snapshot trace their own small
+            # programs on first use, and those belong to the sanctioned
+            # reshard window (like the new mesh's round-program compile
+            # during the next round) — anything traced during the
+            # steady-state rounds before this boundary is still a bug
+            counts = compile_event_counts()
+            d_tr = counts["traces"] - san_warmup["traces"]
+            d_co = counts["compiles"] - san_warmup["compiles"]
+            if d_tr or d_co:
+                san["retrace_count"] += d_tr
+                san["recompile_count"] += d_co
+                raise RuntimeError(
+                    f"sanitizer: retrace budget exceeded before the "
+                    f"round-{rnd} membership change — post-warmup rounds "
+                    f"added {d_tr} jaxpr trace(s) and {d_co} backend "
+                    "compile(s)")
+            san_warmup = None   # next completed round re-baselines
+        t0 = time.perf_counter()
+        # fold every recorded wall into the EMA now: the snapshot must
+        # carry the fully-updated heterogeneity estimate, and the
+        # continuation starts with an empty wall history — exactly like
+        # a fresh run from the snapshot
+        consume_walls(upto=rnd)
+        walls_by_round.clear()
+        next_wall_box[0] = rnd
+        if policy is not None:
+            # clear ALL retry state, not just the departed workers': the
+            # snapshot carries no attempt counters, so the fresh-twin's
+            # policy starts empty — resetting here keeps the continued
+            # run's post-boundary straggler verdicts identical to the
+            # twin's (a surviving mid-retry straggler gets its base
+            # deadline back; the membership change re-arms every budget)
+            policy.reset()
+        state = engine.checkpoint_fence(state)
+        snap = elastic_lib.build_snapshot(
+            epoch=rnd, change=change, old_state=state,
+            sec_per_batch=sec_per_batch, seed=cfg.seed,
+            num_classes=num_classes, trainset_len=len(trainset),
+            valset_len=len(valset), proportionality=cfg.proportionality,
+            data_mode=cfg.data_mode, fixed_ratio=cfg.fixed_ratio,
+            rng=rng, trainset_labels=trainset.labels,
+            valset_labels=valset.labels, next_worker_id=plan.next_id,
+            n_round0=n_round0)
+        el["snapshots"].append(elastic_lib.snapshot_copy(snap))
+        install_from_snapshot(snap)
+        el["events"].extend(change.applied)
+        reshard_ms = round((time.perf_counter() - t0) * 1e3, 3)
+        el["reshard_ms"].append(reshard_ms)
+        log.info("elastic: round %d boundary applied %s -> %d worker(s) "
+                 "%s; reshard stall %.1f ms", rnd, change.applied, n,
+                 worker_ids, reshard_ms)
+        # the prep built for this round under the OLD membership is dead;
+        # rebuild from the snapshot partitions (the fresh-run twin runs
+        # the identical make_prep at its setup)
+        prep = make_prep(train_parts, val_parts)
+
     try:
         for global_epoch in epoch_iter:
             # fail fast on metric-worker errors: a fetch/assembly failure
@@ -887,6 +1190,10 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             # not after every remaining round has burned device time
             while pending and pending[0].done():
                 pending.pop(0).result()
+            if elastic_on:
+                membership_boundary(global_epoch)
+                if n < n_start:
+                    el["rounds_degraded"] += 1
             results["step_caps"].append(list(prep["caps"]))
             results["shard_sizes"].append(list(prep["sizes"]))
             # zero-filled checkpoint walls (sync_ms convention: the schema
@@ -929,7 +1236,8 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             cur_steps_run = prep["steps_run"]
             if overlap:
                 pending.append(executor.submit(
-                    metrics_job, handle, global_epoch, t_disp, timing))
+                    metrics_job, handle, global_epoch, t_disp, timing,
+                    list(worker_ids)))
             ckpt_due = bool(cfg.checkpoint_dir and cfg.checkpoint_every
                             and (global_epoch + 1) % cfg.checkpoint_every
                             == 0)
@@ -982,7 +1290,8 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                             "donation was declined (check in/out "
                             "sharding match of the round program)")
             if not overlap:
-                metrics_job(handle, global_epoch, t_disp, timing)
+                metrics_job(handle, global_epoch, t_disp, timing,
+                            list(worker_ids))
                 if not last_round:
                     t0 = time.perf_counter()
                     prep = prepare_next(global_epoch, cur_steps_run)
@@ -1097,6 +1406,24 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             log.info("sanitizer: 0 transfer-guard violations, 0 "
                      "donation failures; retrace budget NOT enforced "
                      "(jax monitoring unavailable)")
+
+    # elastic-membership provenance (ISSUE 8): recorded like sync_engine/
+    # sanitize — every run artifact states whether the elastic harness was
+    # armed and what it did (events applied/rejected, straggler retries,
+    # per-event reshard stalls, rounds run below the starting quorum).
+    # "snapshots" carries a deep copy of every membership boundary's
+    # post-event configuration: the fresh-run twin
+    # (train_global(cfg, elastic_snapshot=snap)) starts from one to prove
+    # the bitwise loss-trajectory gate.
+    el["final_worker_ids"] = list(worker_ids)
+    results["elastic"] = el
+    if el["events"]:
+        log.info("elastic: %d membership event(s), %d rejected, %d "
+                 "straggler retries, reshard stalls %s ms, %d round(s) "
+                 "degraded, final membership %s",
+                 len(el["events"]), len(el["rejected"]),
+                 len(el["sync_retries"]), el["reshard_ms"],
+                 el["rounds_degraded"], el["final_worker_ids"])
 
     results["state"] = state
     results["mesh"] = mesh

@@ -1,0 +1,322 @@
+"""Elastic worker membership (ISSUE 8 tentpole).
+
+PR 5's checkpoint restore already re-shards a ``TrainState`` onto a
+different mesh — at RESTART time.  This module promotes that path to a
+round-boundary, in-process operation: on a membership-change event the
+driver
+
+1. snapshots the surviving state to host (the same copy-not-view
+   device->host path the checkpoint engine uses),
+2. row-edits the worker axis (drop departed rows; joiners clone the
+   first survivor's row with a fresh per-worker RNG stream and a zero
+   error-feedback residual),
+3. rebuilds the worker mesh over the new data-axis size
+   (``mesh.resize_data_axis`` — inner TP/PP/SP/EP axes are untouched),
+4. constructs a fresh ``LocalSGDEngine`` on it (which re-buckets the
+   sync engine and rebuilds the gossip ring/double-ring ppermute
+   neighbor tables from the new axis size — a departed worker can never
+   strand the ring), and
+5. ``stage_state``s the edited host tree onto the new mesh — the PR 5
+   ``device_put``-onto-template-shardings reshard, in process.
+
+The WHOLE post-event configuration is captured in a
+``MembershipSnapshot`` first, and the in-process continuation installs
+itself FROM that snapshot — the identical code path a fresh
+``train_global(cfg, elastic_snapshot=snap)`` run takes.  That shared
+path is what makes the ISSUE's correctness gate mechanical: the
+continued run and a fresh run started from the same snapshot execute
+byte-identical staging and therefore bitwise-identical (fp32) loss
+trajectories.
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import logging
+import os
+from typing import Any
+
+import jax
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+# Test hook (crash-during-reshard -> checkpoint-resume coverage): raise
+# at the defined point INSIDE the membership transition — after the old
+# engine's state is snapshotted but before the new mesh/engine exist —
+# so the recovery path (resume from the last committed checkpoint and
+# REPLAY the deterministic chaos schedule) is exercised end to end.
+_CRASH_ENV = "JAX_GRAFT_ELASTIC_TEST_CRASH"
+
+
+def _maybe_crash(point: str) -> None:
+    if os.environ.get(_CRASH_ENV) == point:
+        raise RuntimeError(
+            f"elastic test crash hook fired at {point!r} "
+            f"({_CRASH_ENV})")
+
+
+@dataclasses.dataclass
+class MembershipSnapshot:
+    """Everything a run needs to continue from a membership boundary.
+
+    ``host_state`` is a host-numpy ``TrainState`` whose leaves carry the
+    NEW worker axis; ``epoch`` is the next round to run.  ``rng_state``
+    is the numpy bit-generator state driving the re-partition draws —
+    captured so a fresh run consumes the identical random stream the
+    in-process continuation does (the bitwise-gate requirement)."""
+
+    epoch: int
+    worker_ids: list[int]
+    host_state: Any
+    sec_per_batch: np.ndarray
+    train_parts: list[np.ndarray]
+    val_parts: list[np.ndarray]
+    fixed_classes: list | None
+    rng_state: dict
+    # the plan's id allocator position: a fresh-twin run must hand LATER
+    # joiners the same never-recycled logical ids the continued run
+    # does.  max(worker_ids)+1 is NOT equivalent — killing the max-id
+    # worker before the snapshot would recycle its id (and its fold_in
+    # RNG stream), bitwise-diverging the runs at the next join.
+    next_worker_id: int = 0
+    # the run's ROUND-0 worker count (roster 0..n-1): a fresh twin pins
+    # random-mode slow/stall targets against this roster, exactly as the
+    # original run did — its own starting roster is the post-change one,
+    # which would pin (and so perturb) different workers.
+    n_round0: int = 0
+
+    @property
+    def n_workers(self) -> int:
+        return len(self.worker_ids)
+
+
+@dataclasses.dataclass
+class MembershipChange:
+    """Resolved outcome of one boundary's membership events."""
+
+    kept_positions: list[int]     # old-mesh rows that survive, in order
+    worker_ids: list[int]         # new logical-id order (survivors+joins)
+    joiner_ids: list[int]
+    applied: list[dict]           # event descriptions, as applied
+    rejected: list[dict]          # events refused (quorum/capacity/...)
+
+    @property
+    def changed(self) -> bool:
+        return bool(self.joiner_ids) or bool(self.applied)
+
+
+class MembershipPlan:
+    """Tracks the logical worker roster and resolves membership events
+    against the quorum floor and the device-capacity ceiling.
+
+    Logical ids are stable for the life of the run: the initial workers
+    are 0..N-1 and every joiner takes the next free id (ids are never
+    recycled, so a joiner's RNG stream can never collide with any
+    worker's — past or present)."""
+
+    def __init__(self, n_workers: int, *, min_workers: int = 1,
+                 max_workers: int | None = None,
+                 worker_ids: list[int] | None = None,
+                 next_id: int | None = None):
+        self.worker_ids = (list(worker_ids) if worker_ids is not None
+                           else list(range(n_workers)))
+        self.min_workers = max(1, int(min_workers))
+        self.max_workers = max_workers
+        # next_id: a snapshot-restored plan must resume the continued
+        # run's allocator position (MembershipSnapshot.next_worker_id),
+        # NOT recompute it — max+1 recycles a killed max-id worker's id
+        floor = (max(self.worker_ids) + 1 if self.worker_ids else 0)
+        self._next_id = floor if next_id is None else max(floor,
+                                                          int(next_id))
+
+    @property
+    def n_workers(self) -> int:
+        return len(self.worker_ids)
+
+    @property
+    def next_id(self) -> int:
+        """The allocator position to persist into snapshots."""
+        return self._next_id
+
+    def apply(self, events, resolve=None) -> MembershipChange:
+        """Resolve kill/join/depart events into a ``MembershipChange``.
+
+        ``resolve(event, worker_ids)`` maps a random event's fractional
+        target to a logical id (``ChaosSchedule.resolve_target``);
+        scripted events carry their target directly.  Events that would
+        sink the roster below ``min_workers`` or grow it past
+        ``max_workers`` (the device-capacity ceiling) are REJECTED and
+        recorded, never partially applied — graceful degradation keeps
+        the surviving quorum training."""
+        ids = list(self.worker_ids)
+        joiners: list[int] = []
+        applied: list[dict] = []
+        rejected: list[dict] = []
+        next_id = self._next_id
+        # departures resolve before joins at the same boundary: a kill
+        # frees the device position its worker held, so a simultaneous
+        # kill+join on a full mesh is a swap, not a capacity rejection
+        order = {"kill": 0, "depart": 0}
+        events = sorted(events, key=lambda e: order.get(
+            e.kind if hasattr(e, "kind") else e["kind"], 1))
+        for e in events:
+            kind = e.kind if hasattr(e, "kind") else e["kind"]
+            desc = e.describe() if hasattr(e, "describe") else dict(e)
+            if kind in ("kill", "depart"):
+                target = (resolve(e, ids) if resolve is not None
+                          and getattr(e, "worker", None) is None
+                          else getattr(e, "worker", None))
+                if target is None or target not in ids:
+                    rejected.append({**desc, "reason":
+                                     f"worker {target} not in membership"})
+                    continue
+                if len(ids) + len(joiners) - 1 < self.min_workers:
+                    rejected.append({**desc, "reason":
+                                     f"quorum floor {self.min_workers}"})
+                    continue
+                ids.remove(target)
+                applied.append({**desc, "worker": int(target)})
+            elif kind == "join":
+                if (self.max_workers is not None
+                        and len(ids) + len(joiners) + 1 > self.max_workers):
+                    rejected.append({**desc, "reason":
+                                     f"device capacity {self.max_workers}"})
+                    continue
+                joiners.append(next_id)
+                applied.append({**desc, "worker": int(next_id)})
+                next_id += 1
+            else:
+                rejected.append({**desc, "reason":
+                                 f"not a membership event kind {kind!r}"})
+        kept_positions = [self.worker_ids.index(w) for w in ids]
+        change = MembershipChange(
+            kept_positions=kept_positions, worker_ids=ids + joiners,
+            joiner_ids=joiners, applied=applied, rejected=rejected)
+        if change.applied:
+            self.worker_ids = change.worker_ids
+            self._next_id = next_id
+        return change
+
+
+# ----------------------------------------------------------------------
+# State reshard: host row edit + restage (the PR 5 path, in process)
+# ----------------------------------------------------------------------
+
+def host_state_snapshot(state):
+    """Copy a (possibly in-flight-materialized) device ``TrainState`` to
+    host numpy — the caller fences first (``engine.checkpoint_fence`` /
+    ``round_wait`` already did at a round boundary).  Arrays are copies,
+    never views: once this returns, the old engine's buffers may be
+    donated or freed."""
+    return jax.tree_util.tree_map(
+        lambda x: np.array(x, copy=True) if isinstance(x, jax.Array)
+        else np.asarray(x), state)
+
+
+def reshard_state(host_state, kept_positions: list[int],
+                  joiner_ids: list[int], *, seed: int):
+    """Row-edit a host-numpy worker-stacked ``TrainState`` for a
+    membership change.
+
+    Survivor rows are taken verbatim (``np.take`` — bit-exact), in their
+    old relative order.  Each joiner clones the FIRST survivor's row
+    (params, BatchNorm stats, Adam moments, StepLR clock — the same
+    bootstrap a fresh worker would get from the reference's rank-0
+    broadcast, applied to the current consensus instead of the init),
+    with two exceptions: its RNG row is a fresh
+    ``fold_in(key(seed), logical_id)`` stream (ids are never recycled,
+    so the stream is unique for the life of the run), and its
+    error-feedback ``sync_residual`` rows are ZERO — a cloned residual
+    would re-inject the donor's accumulated quantization error twice."""
+    if not kept_positions:
+        raise ValueError("membership change left no surviving workers")
+    take = lambda x: np.take(np.asarray(x), kept_positions, axis=0)
+    base = jax.tree_util.tree_map(take, host_state)
+    k = len(joiner_ids)
+    if not k:
+        return base
+    clone = lambda x: np.concatenate(
+        [x, np.repeat(x[:1], k, axis=0)], axis=0)
+    out = jax.tree_util.tree_map(clone, base)
+    nk = len(kept_positions)
+    rng_rows = np.stack([
+        np.asarray(jax.random.key_data(
+            jax.random.fold_in(jax.random.key(seed), int(wid))))
+        for wid in joiner_ids]).astype(out.rng.dtype)
+    rng = out.rng.copy()
+    rng[nk:] = rng_rows
+    zero_res = out.sync_residual
+    if zero_res is not None:
+        def z(x):
+            y = x.copy()
+            y[nk:] = 0
+            return y
+        zero_res = jax.tree_util.tree_map(z, out.sync_residual)
+    return out.replace(rng=rng, sync_residual=zero_res)
+
+
+def build_snapshot(*, epoch: int, change: MembershipChange, old_state,
+                   sec_per_batch: np.ndarray, seed: int,
+                   num_classes: int, trainset_len: int, valset_len: int,
+                   proportionality: str, data_mode: str,
+                   fixed_ratio: float, rng: np.random.Generator,
+                   trainset_labels=None, valset_labels=None,
+                   joiner_spb_mode: str = "mean",
+                   next_worker_id: int = 0,
+                   n_round0: int = 0) -> MembershipSnapshot:
+    """Assemble the full post-event configuration for round ``epoch``.
+
+    Runs entirely on host state: the survivor-EMA edit (departed rows
+    dropped, joiners seeded via ``probe.joiner_sec_per_batch``), the
+    adaptive re-partition re-drawn from that EMA
+    (``data.adaptive_partition`` — the departed worker's shard
+    redistributes across the survivors' shares), and the row-edited host
+    ``TrainState``.  The caller's ``rng`` is consumed by the skew draws
+    (disbalanced mode) and its state captured LAST, so a fresh run
+    restoring this snapshot continues the identical random stream."""
+    from . import probe as probe_lib
+    from .data import adaptive_partition, fixed_classes_for_rank
+
+    spb = np.asarray(sec_per_batch, np.float64)[change.kept_positions]
+    if change.joiner_ids:
+        fill = probe_lib.joiner_sec_per_batch(spb, mode=joiner_spb_mode)
+        spb = np.concatenate([spb, np.full(len(change.joiner_ids), fill)])
+    from .data import efficiency_ratios
+    ratios = efficiency_ratios(spb, proportionality)
+    fixed_classes = None
+    if data_mode == "disbalanced":
+        fixed_classes = [fixed_classes_for_rank(wid, num_classes)
+                         for wid in change.worker_ids]
+    train_parts = adaptive_partition(
+        trainset_len, ratios, labels=trainset_labels,
+        fixed_classes=fixed_classes, fixed_ratio=fixed_ratio, rng=rng)
+    val_parts = adaptive_partition(
+        valset_len, ratios, labels=valset_labels,
+        fixed_classes=fixed_classes, fixed_ratio=fixed_ratio, rng=rng)
+    host_state = reshard_state(
+        host_state_snapshot(old_state), change.kept_positions,
+        change.joiner_ids, seed=seed)
+    _maybe_crash("mid_reshard")
+    return MembershipSnapshot(
+        epoch=int(epoch), worker_ids=list(change.worker_ids),
+        host_state=host_state, sec_per_batch=spb,
+        train_parts=train_parts, val_parts=val_parts,
+        fixed_classes=fixed_classes,
+        rng_state=copy.deepcopy(rng.bit_generator.state),
+        next_worker_id=int(next_worker_id), n_round0=int(n_round0))
+
+
+def snapshot_copy(snap: MembershipSnapshot) -> MembershipSnapshot:
+    """Deep copy for ``results`` capture: the driver keeps mutating the
+    live partition lists the snapshot references."""
+    return MembershipSnapshot(
+        epoch=snap.epoch, worker_ids=list(snap.worker_ids),
+        host_state=jax.tree_util.tree_map(np.copy, snap.host_state),
+        sec_per_batch=snap.sec_per_batch.copy(),
+        train_parts=[p.copy() for p in snap.train_parts],
+        val_parts=[p.copy() for p in snap.val_parts],
+        fixed_classes=copy.deepcopy(snap.fixed_classes),
+        rng_state=copy.deepcopy(snap.rng_state),
+        next_worker_id=snap.next_worker_id, n_round0=snap.n_round0)

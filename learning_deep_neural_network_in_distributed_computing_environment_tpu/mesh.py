@@ -112,6 +112,38 @@ def build_mesh(axes: dict[str, int] | None = None,
     return Mesh(grid, tuple(axes.keys()))
 
 
+def max_data_axis_size(mesh: Mesh) -> int:
+    """Device-capacity ceiling for the elastic data axis: how many worker
+    positions the available devices can host given the mesh's inner
+    (non-data) axes.  A join past this is rejected, not crashed on."""
+    inner = math.prod(int(s) for a, s in mesh.shape.items()
+                      if a != DATA_AXIS)
+    return len(jax.devices()) // max(1, inner)
+
+
+def resize_data_axis(mesh: Mesh, n: int) -> Mesh:
+    """A new mesh with the ``data`` axis resized to ``n`` workers — the
+    membership-boundary mesh rebuild (ISSUE 8).
+
+    Inner (TP/PP/SP/EP) axes keep their sizes and order; devices come
+    from ``jax.devices()`` exactly as ``build_mesh`` assigns them, so a
+    fresh run configured with ``n`` workers builds the IDENTICAL mesh —
+    the property the elastic bitwise gate relies on.  Raises when the
+    available devices cannot host ``n`` workers times the inner axes."""
+    if n < 1:
+        raise ValueError(f"data axis must keep >= 1 worker, got {n}")
+    axes = {a: (n if a == DATA_AXIS else int(s))
+            for a, s in mesh.shape.items()}
+    if DATA_AXIS not in axes:
+        axes = {DATA_AXIS: n, **axes}
+    total = math.prod(axes.values())
+    if total > len(jax.devices()):
+        raise ValueError(
+            f"elastic resize to {n} workers needs {total} devices "
+            f"(mesh {axes}), only {len(jax.devices())} available")
+    return build_mesh(axes)
+
+
 def data_sharding(mesh: Mesh, *, extra_dims: int = 0) -> NamedSharding:
     """Sharding for a [global_batch, ...] array split over the data axis."""
     return NamedSharding(mesh, P(DATA_AXIS, *([None] * extra_dims)))

@@ -89,6 +89,31 @@ def gather_durations(local_duration: float, world_size: int,
     return np.full(world_size, local_duration, np.float64)
 
 
+def joiner_sec_per_batch(survivor_spb: np.ndarray,
+                         mode: str = "mean") -> float:
+    """Probe-EMA seed for a worker JOINING mid-run (ISSUE 8).
+
+    A joiner has no probe measurement and no wall history, so its
+    sec/batch entry — which drives its step cap and shard share until
+    measured walls blend in — is synthesized from the survivors' EMA:
+    ``mean`` assumes fleet-typical hardware (default); ``max`` is the
+    conservative choice (smallest initial shard/cap, so a slow joiner
+    cannot straggle its first round); ``min`` the optimistic one.  The
+    delayed-EMA feedback corrects whichever guess within two rounds."""
+    spb = np.asarray(survivor_spb, np.float64)
+    if spb.size == 0 or np.any(spb <= 0):
+        raise ValueError(
+            f"survivor sec/batch vector must be non-empty and positive, "
+            f"got {survivor_spb!r}")
+    if mode == "mean":
+        return float(spb.mean())
+    if mode == "max":
+        return float(spb.max())
+    if mode == "min":
+        return float(spb.min())
+    raise ValueError(f"unknown joiner_sec_per_batch mode {mode!r}")
+
+
 def estimate_epoch_duration(model, variables, sample_batch: np.ndarray,
                             world_size: int, num_batches: int = 10,
                             simulated_durations=None):

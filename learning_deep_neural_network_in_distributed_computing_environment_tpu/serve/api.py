@@ -148,7 +148,9 @@ def run_serve(cfg, requests: Optional[list] = None, *,
                 engine, eos_id=cfg.serve_eos_id).run(warm)
             warmup_counts = compile_event_counts()
 
-    sched = ContinuousBatchingScheduler(engine, eos_id=cfg.serve_eos_id)
+    sched = ContinuousBatchingScheduler(
+        engine, eos_id=cfg.serve_eos_id,
+        request_timeout=cfg.serve_request_timeout)
     telemetry = sched.run(requests)
     completions = telemetry.pop("completions")
     telemetry["retrace_count"] = 0

@@ -62,6 +62,7 @@ class _Slot:
     generated: list
     latencies: list
     t_last: float
+    t_admit: float = 0.0          # wall clock at admission (timeout base)
 
 
 class ContinuousBatchingScheduler:
@@ -70,13 +71,23 @@ class ContinuousBatchingScheduler:
     naive sequential-request baseline the bench A/Bs against."""
 
     def __init__(self, engine: ServeEngine, *, eos_id: int = -1,
-                 max_active: Optional[int] = None):
+                 max_active: Optional[int] = None,
+                 request_timeout: float = 0.0):
         self.engine = engine
         self.eos_id = int(eos_id)
         self.max_active = min(int(max_active or engine.max_batch),
                               engine.max_batch)
+        # per-request wall-clock budget (ISSUE 8 satellite): an admitted
+        # sequence still decoding past this many seconds is evicted
+        # (reason "timeout") so a stuck request frees its slot and pages
+        # instead of pinning them forever; 0 disables
+        self.request_timeout = float(request_timeout)
+        if self.request_timeout < 0:
+            raise ValueError(
+                f"request_timeout must be >= 0, got {request_timeout}")
         self.stats = {"admitted": 0, "evicted": 0, "admission_blocked": 0,
-                      "decode_steps": 0, "tokens_generated": 0}
+                      "decode_steps": 0, "tokens_generated": 0,
+                      "timed_out": 0}
         self._occupancy: list[int] = []
 
     # -- request validation (fail at submit, not mid-run) ---------------
@@ -128,7 +139,8 @@ class ContinuousBatchingScheduler:
         slot = _Slot(rid=r.rid, pages=pages, row=row,
                      length=len(r.prompt), temperature=r.temperature,
                      max_new=r.max_new_tokens, generated=[first],
-                     latencies=[now - (t0 + r.arrival_s)], t_last=now)
+                     latencies=[now - (t0 + r.arrival_s)], t_last=now,
+                     t_admit=now)
         slots[free_slot] = slot
         self.stats["admitted"] += 1
         self.stats["tokens_generated"] += 1
@@ -170,6 +182,18 @@ class ContinuousBatchingScheduler:
         t0 = time.perf_counter()
         while queue or any(s is not None for s in slots):
             now = time.perf_counter() - t0
+            if self.request_timeout > 0:
+                # evict sequences over their wall-clock budget BEFORE this
+                # iteration's admissions and decode dispatch: the freed
+                # slot + pages are immediately available to the queue
+                # behind them, so one stuck request cannot starve it
+                t_now = time.perf_counter()
+                for i, s in enumerate(slots):
+                    if (s is not None
+                            and t_now - s.t_admit > self.request_timeout):
+                        self.stats["timed_out"] += 1
+                        done[s.rid] = self._finish(s, "timeout")
+                        slots[i] = None
             # admit every due request a slot + pages can take, in order
             while queue and queue[0].arrival_s <= now:
                 if not self._admit(queue[0], slots, t0):
@@ -243,6 +267,7 @@ class ContinuousBatchingScheduler:
             "admitted": self.stats["admitted"],
             "evicted": self.stats["evicted"],
             "admission_blocked": self.stats["admission_blocked"],
+            "timed_out": self.stats["timed_out"],
             "decode_steps": self.stats["decode_steps"],
             "tokens_generated": self.stats["tokens_generated"],
             "wall_s": round(wall, 4),

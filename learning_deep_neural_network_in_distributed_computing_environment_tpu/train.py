@@ -530,7 +530,16 @@ class LocalSGDEngine:
     def _put(self, a, spec):
         sharding = NamedSharding(self.mesh, spec)
         if jax.process_count() == 1:
-            return jax.device_put(jnp.asarray(a), sharding)
+            out = jax.device_put(jnp.asarray(a), sharding)
+            if isinstance(a, np.ndarray):
+                # host-numpy source (elastic/checkpoint restage, not the
+                # init_state device path): materialize an XLA-owned
+                # buffer before the round program can DONATE it — on
+                # jax 0.4.x XLA:CPU the put can zero-copy alias
+                # numpy-owned malloc memory (checkpoint._reshard_leaf
+                # documents the resulting heap corruption)
+                out = jax.block_until_ready(out).copy()
+            return out
         a = np.asarray(a)
         return jax.make_array_from_process_local_data(
             sharding, self._local_rows(a), a.shape)
@@ -563,6 +572,11 @@ class LocalSGDEngine:
         # one-shot per engine: init runs exactly once per train_global
         # graftlint: disable=R2 -- single Xavier-init trace, not a loop
         params, batch_stats, opt_state = jax.jit(_init)(rng)
+        if self.param_specs_fn is not None and self.param_specs is None:
+            # derive TP/PP/EP specs from the per-worker template while it
+            # is in hand: stage_state's lazy fallback would otherwise pull
+            # the whole n-stacked device tree to host just to read row 0
+            self.param_specs = self.param_specs_fn(params)
 
         def tile(tree):
             return jax.tree_util.tree_map(
@@ -579,9 +593,26 @@ class LocalSGDEngine:
                 lambda x: jnp.zeros((n, *x.shape), jnp.float32), params)
                 if self.sync_ef else None),
         )
+        return self.stage_state(state)
+
+    def stage_state(self, state: TrainState) -> TrainState:
+        """Stage a worker-stacked ``TrainState`` (host numpy or device
+        arrays) onto this engine's mesh with the engine's shardings.
+
+        This is the PR 5 restore path promoted to an engine surface
+        (ISSUE 8): ``init_state`` routes its freshly-tiled state through
+        it, and the elastic membership layer hands it the row-edited
+        HOST snapshot of the previous mesh's state — the in-process
+        cross-mesh reshard.  Under TP/PP/EP the param specs are derived
+        lazily from the state's own (squeezed) parameter structure, so a
+        snapshot-restored engine never needs an ``init_state`` call."""
         if self.param_specs_fn is not None:
-            self.param_specs = self.param_specs_fn(params)
-            self._sspec = self._build_state_specs(state)
+            if self.param_specs is None:
+                p0 = jax.tree_util.tree_map(
+                    lambda x: np.asarray(x)[0], state.params)
+                self.param_specs = self.param_specs_fn(p0)
+            if self._sspec is None:
+                self._sspec = self._build_state_specs(state)
             return jax.tree_util.tree_map(
                 lambda x, s: self._put(x, s), state, self._sspec)
         return jax.tree_util.tree_map(

@@ -112,6 +112,30 @@ def test_crash_fallback_to_previous_committed(mesh8, tmp_path):
     _assert_trees_equal(restored, state)
 
 
+def test_corrupt_same_size_shard_falls_back(mesh8, tmp_path):
+    """Bit rot / a torn overwrite that PRESERVES the byte size must drop
+    the epoch exactly like truncation does (ISSUE 8 satellite): the
+    manifest's crc32 is validated at listing time, so ``latest`` falls
+    back to the previous committed epoch instead of crashing (or worse,
+    restoring garbage) at restore."""
+    engine, state = _mlp_state(mesh8, seed=0)
+    eng = C.CheckpointEngine(str(tmp_path), async_write=False)
+    eng.save(state, 1)
+    eng.save(state, 2)
+    sh = tmp_path / "ckpt_2" / "shard_0.msgpack"
+    raw = bytearray(sh.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF           # flip bits, keep the size
+    sh.write_bytes(bytes(raw))
+    assert os.path.getsize(sh) == len(raw)
+    assert C.committed_epochs(str(tmp_path)) == [1]
+    latest = C.latest_checkpoint(str(tmp_path))
+    assert latest.endswith("ckpt_1")
+    _, template = _mlp_state(mesh8, seed=1)
+    restored, epoch = C.restore_checkpoint(latest, template)
+    assert epoch == 1
+    _assert_trees_equal(restored, state)
+
+
 def test_missing_shard_falls_back(mesh8, tmp_path):
     """A manifested epoch with a LOST (not just truncated) shard file is
     exactly as unrestorable — it must drop out of the committed listing

@@ -1,0 +1,640 @@
+"""Elastic worker membership + chaos-injection harness (ISSUE 8).
+
+The tentpole gate is the ROADMAP's: kill/add a worker mid-run in the
+simulated N-worker CPU driver and BITWISE-match (fp32) the post-event
+loss trajectory of a fresh run started from the same membership
+snapshot — under ``--sanitize``, with zero post-warmup retraces outside
+the sanctioned reshard recompile.  Around it: the chaos grammar, the
+straggler retry/timeout/backoff protocol, quorum/capacity graceful
+degradation, the ring-neighbor rebuild across all three topologies, and
+crash-during-reshard -> checkpoint-resume replay.
+
+Walls are pinned via ``simulated_round_durations`` (membership-aware
+vectors): the only nondeterminism left would be the elastic transition
+itself, which must introduce none.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+from learning_deep_neural_network_in_distributed_computing_environment_tpu import (  # noqa: E402
+    chaos as chaos_lib,
+    elastic as elastic_lib,
+    mesh as mesh_lib,
+    probe as probe_lib,
+)
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.comms import (  # noqa: E402
+    ring_neighbors,
+)
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import (  # noqa: E402
+    Config,
+)
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.data import (  # noqa: E402
+    adaptive_partition,
+    contiguous_partition,
+    efficiency_ratios,
+    fixed_classes_for_rank,
+    skew_partition,
+)
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import (  # noqa: E402
+    train_global,
+)
+
+
+# ----------------------------------------------------------------------
+# Chaos grammar + schedule
+# ----------------------------------------------------------------------
+
+class TestChaosSpec:
+    def test_parses_all_kinds(self):
+        ev = chaos_lib.parse_chaos_spec(
+            "kill@2:w1, join@3; slow@1:w0x2.5, stall@4:w2+30*2")
+        kinds = [(e.kind, e.round) for e in ev]
+        assert kinds == [("slow", 1), ("kill", 2), ("join", 3),
+                         ("stall", 4)]          # sorted by (round, kind)
+        assert ev[0].factor == 2.5 and ev[0].worker == 0
+        assert ev[3].seconds == 30.0 and ev[3].rounds == 2
+
+    @pytest.mark.parametrize("bad", [
+        "explode@2:w1",        # unknown kind
+        "kill@0:w1",           # round 0 is the initial membership
+        "kill@2",              # kill needs a target
+        "slow@2:w1",           # slow needs a positive factor
+        "stall@2:w1",          # stall needs positive seconds
+        "kill@2:w1 join@3",    # missing separator
+        "join@3:w5",           # joiners take the next free id, not :w
+        "kill@2:w1+30",        # +seconds is stall-only
+        "kill@1:w0x2",         # xfactor is slow-only
+        "slow@2:w1x2*3",       # *rounds is stall-only (slow persists)
+    ])
+    def test_malformed_specs_raise(self, bad):
+        with pytest.raises(ValueError):
+            chaos_lib.parse_chaos_spec(bad)
+
+    def test_config_validates_spec_eagerly(self):
+        with pytest.raises(ValueError, match="chaos"):
+            Config(chaos="kill@2")          # typo fails at config time
+        with pytest.raises(ValueError, match="elastic_min_workers"):
+            Config(elastic_min_workers=0)
+        with pytest.raises(ValueError, match="chaos_grace"):
+            Config(chaos_grace=-1.0)
+
+    def test_random_schedule_reconstructable_from_seed(self):
+        a = chaos_lib.random_events(7, 5, epochs_global=10)
+        b = chaos_lib.random_events(7, 5, epochs_global=10)
+        assert a == b and len(a) == 5
+        assert all(1 <= e.round < 10 for e in a)
+        # random kills carry a fractional target resolved against the
+        # live roster at apply time
+        sched = chaos_lib.ChaosSchedule(a)
+        for e in a:
+            wid = sched.resolve_target(e, [0, 2, 5])
+            assert wid in (0, 2, 5)
+
+    def test_random_wall_faults_pinned_to_logical_ids(self):
+        # --chaos random: slow/stall targets resolve ONCE against the
+        # round-0 roster; a membership change must not migrate a
+        # persistent fault to whichever worker now occupies the frac's
+        # roster position (and a pinned target that departs simply stops
+        # perturbing — the fault followed the worker out)
+        cfg = Config(model="mlp", dataset="mnist", chaos="random",
+                     chaos_seed=3, chaos_events=12, epochs_global=8,
+                     num_workers=4)
+        sched = chaos_lib.ChaosSchedule.from_config(cfg)
+        walls = [e for e in sched.events if e.kind in ("slow", "stall")]
+        assert walls and all(e.worker is not None for e in walls)
+        # driver-path pinning (num_workers=0 runs) is idempotent: a
+        # second pin against a DIFFERENT roster must not re-target
+        pinned = [e.worker for e in sched.events
+                  if e.kind in ("slow", "stall")]
+        sched.pin_wall_targets([7, 8, 9])
+        assert [e.worker for e in sched.events
+                if e.kind in ("slow", "stall")] == pinned
+        e = walls[0]
+        wid = e.worker
+        full = list(range(4))
+        before = sched.perturb_walls(e.round, full, np.ones(4))
+        assert before[full.index(wid)] != 1.0
+        shrunk = [w for w in full if w != wid]
+        after = sched.perturb_walls(e.round, shrunk,
+                                    np.ones(len(shrunk)))
+        others = [e2 for e2 in walls[1:]
+                  if e2.round <= e.round and e2.worker in shrunk]
+        if not others:   # no other fault lands here: nothing perturbed
+            assert after.tolist() == np.ones(len(shrunk)).tolist()
+
+    def test_perturb_walls_slow_persists_stall_windows(self):
+        ev = chaos_lib.parse_chaos_spec("slow@2:w1x3,stall@3:w0+10*2")
+        sched = chaos_lib.ChaosSchedule(ev)
+        ids = [0, 1, 2]
+        ones = np.ones(3)
+        assert sched.perturb_walls(1, ids, ones).tolist() == [1, 1, 1]
+        assert sched.perturb_walls(2, ids, ones).tolist() == [1, 3, 1]
+        assert sched.perturb_walls(3, ids, ones).tolist() == [11, 3, 1]
+        assert sched.perturb_walls(4, ids, ones).tolist() == [11, 3, 1]
+        assert sched.perturb_walls(5, ids, ones).tolist() == [1, 3, 1]
+        # keyed by LOGICAL id: the perturbation follows the worker when
+        # the roster reshuffles
+        assert sched.perturb_walls(2, [2, 1], np.ones(2)).tolist() == [1, 3]
+
+
+class TestStragglerPolicy:
+    def test_retry_backoff_then_departure(self):
+        pol = chaos_lib.StragglerPolicy(
+            time_limit=10.0, grace=5.0, retries=1, backoff=0.5)
+        ids = [0, 1]
+        # round 1: worker 1 overruns 15s deadline -> tolerated retry,
+        # deadline extends to 10 + 5*1.5 = 17.5
+        departed, retries = pol.observe(ids, np.array([1.0, 16.0]))
+        assert departed == [] and len(retries) == 1
+        assert retries[0]["worker"] == 1 and retries[0]["attempt"] == 1
+        assert retries[0]["next_deadline_s"] == 17.5
+        # round 2: still past the EXTENDED deadline -> departed
+        departed, retries = pol.observe(ids, np.array([1.0, 18.0]))
+        assert departed == [1] and retries == []
+
+    def test_recovery_resets_attempts(self):
+        pol = chaos_lib.StragglerPolicy(10.0, 5.0, retries=1, backoff=0.5)
+        pol.observe([0], np.array([16.0]))       # retry 1
+        pol.observe([0], np.array([1.0]))        # recovered
+        departed, retries = pol.observe([0], np.array([16.0]))
+        assert departed == [] and retries[0]["attempt"] == 1
+
+
+# ----------------------------------------------------------------------
+# Membership plan + reshard primitives
+# ----------------------------------------------------------------------
+
+class TestMembershipPlan:
+    def test_kill_join_and_id_stability(self):
+        plan = elastic_lib.MembershipPlan(4)
+        ev = chaos_lib.parse_chaos_spec("kill@1:w1,join@1")
+        ch = plan.apply(ev)
+        assert ch.changed and ch.worker_ids == [0, 2, 3, 4]
+        assert ch.kept_positions == [0, 2, 3] and ch.joiner_ids == [4]
+        # ids are never recycled: the next joiner takes 5, not 1
+        ch2 = plan.apply(chaos_lib.parse_chaos_spec("join@2"))
+        assert ch2.worker_ids == [0, 2, 3, 4, 5]
+
+    def test_snapshot_allocator_position_never_recycles_max_id(self):
+        # killing the MAX-id worker must not let a fresh-twin plan
+        # (rebuilt from the snapshot roster) recompute next_id as max+1
+        # and recycle the dead worker's id — that would hand a later
+        # joiner a different fold_in RNG stream than the continued run's
+        plan = elastic_lib.MembershipPlan(4)
+        ch = plan.apply(chaos_lib.parse_chaos_spec("kill@1:w3"))
+        assert ch.worker_ids == [0, 1, 2] and plan.next_id == 4
+        twin = elastic_lib.MembershipPlan(
+            3, worker_ids=ch.worker_ids, next_id=plan.next_id)
+        ch2 = twin.apply(chaos_lib.parse_chaos_spec("join@2"))
+        assert ch2.joiner_ids == [4]          # NOT a recycled 3
+        assert plan.apply(
+            chaos_lib.parse_chaos_spec("join@2")).joiner_ids == [4]
+
+    def test_quorum_floor_rejects_never_partially_applies(self):
+        plan = elastic_lib.MembershipPlan(2, min_workers=2)
+        ch = plan.apply(chaos_lib.parse_chaos_spec("kill@1:w0"))
+        assert not ch.changed and plan.worker_ids == [0, 1]
+        assert ch.rejected and "quorum" in ch.rejected[0]["reason"]
+
+    def test_capacity_ceiling_rejects_join(self):
+        plan = elastic_lib.MembershipPlan(3, max_workers=3)
+        ch = plan.apply(chaos_lib.parse_chaos_spec("join@1"))
+        assert not ch.changed
+        assert "capacity" in ch.rejected[0]["reason"]
+
+    def test_unknown_target_rejected(self):
+        plan = elastic_lib.MembershipPlan(3)
+        ch = plan.apply(chaos_lib.parse_chaos_spec("kill@1:w9"))
+        assert not ch.changed and "not in membership" in \
+            ch.rejected[0]["reason"]
+
+
+class TestRingNeighbors:
+    @pytest.mark.parametrize("n", [2, 3, 5, 8])
+    def test_shift1_is_a_single_full_cycle(self, n):
+        perm = ring_neighbors(n)
+        assert sorted(s for s, _ in perm) == list(range(n))
+        assert sorted(d for _, d in perm) == list(range(n))
+        seen, cur = set(), 0
+        nxt = dict(perm)
+        while cur not in seen:
+            seen.add(cur)
+            cur = nxt[cur]
+        assert seen == set(range(n))   # no stranded sub-ring
+
+    def test_resize_rederives_the_table(self):
+        # the elastic property: the table depends on the axis size alone
+        assert ring_neighbors(4) != ring_neighbors(3)
+        assert ring_neighbors(3, shift=2) == [(0, 2), (1, 0), (2, 1)]
+
+
+class TestMeshResize:
+    def test_resize_matches_fresh_build(self, devices):
+        m4 = mesh_lib.build_mesh({"data": 4})
+        m3 = mesh_lib.resize_data_axis(m4, 3)
+        fresh = mesh_lib.build_mesh({"data": 3})
+        assert m3.shape == fresh.shape
+        assert list(m3.devices.flat) == list(fresh.devices.flat)
+        assert mesh_lib.max_data_axis_size(m4) == 8
+
+    def test_resize_past_capacity_raises(self, devices):
+        m = mesh_lib.build_mesh({"data": 8})
+        with pytest.raises(ValueError, match="devices"):
+            mesh_lib.resize_data_axis(m, 9)
+        with pytest.raises(ValueError, match=">= 1"):
+            mesh_lib.resize_data_axis(m, 0)
+
+
+class TestJoinerSeed:
+    def test_modes(self):
+        spb = np.array([1.0, 2.0, 4.0])
+        assert probe_lib.joiner_sec_per_batch(spb, "mean") == pytest.approx(7 / 3)
+        assert probe_lib.joiner_sec_per_batch(spb, "max") == 4.0
+        assert probe_lib.joiner_sec_per_batch(spb, "min") == 1.0
+        with pytest.raises(ValueError):
+            probe_lib.joiner_sec_per_batch(np.array([]), "mean")
+        with pytest.raises(ValueError):
+            probe_lib.joiner_sec_per_batch(spb, "median")
+
+
+class TestAdaptivePartition:
+    def test_balanced_matches_driver_recipe(self):
+        ratios = efficiency_ratios(np.array([1.0, 2.0, 1.0]), "inverse")
+        assert all(
+            (a == b).all() for a, b in zip(
+                adaptive_partition(100, ratios),
+                contiguous_partition(100, ratios)))
+
+    def test_disbalanced_matches_skew_sequence(self):
+        rng_a, rng_b = (np.random.default_rng(3) for _ in range(2))
+        labels = np.random.default_rng(0).integers(0, 10, 200)
+        ratios = efficiency_ratios(np.array([1.0, 1.0]), "inverse")
+        fixed = [fixed_classes_for_rank(r, 10) for r in range(2)]
+        got = adaptive_partition(200, ratios, labels=labels,
+                                 fixed_classes=fixed, fixed_ratio=0.5,
+                                 rng=rng_a)
+        want = [skew_partition(labels, p, fixed[i], 0.5, rng_b)
+                for i, p in enumerate(contiguous_partition(200, ratios))]
+        assert all((a == b).all() for a, b in zip(got, want))
+        # both rngs consumed the identical draw sequence
+        assert rng_a.bit_generator.state == rng_b.bit_generator.state
+
+    def test_disbalanced_requires_labels_and_rng(self):
+        with pytest.raises(ValueError, match="labels and rng"):
+            adaptive_partition(10, np.array([0.5, 0.5]),
+                               fixed_classes=[[0], [1]])
+
+
+class TestReshardState:
+    def _host_state(self, mesh4):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import get_model
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.train import LocalSGDEngine
+        cfg = Config(model="mlp", batch_size=8, sync_compression="ef",
+                     sync_dtype="bfloat16", aggregation_by="weights")
+        eng = LocalSGDEngine(get_model("mlp", num_classes=10, hidden=8),
+                             mesh4, cfg)
+        state = eng.init_state(jax.random.key(0), np.zeros((8, 28, 28, 1),
+                                                           np.float32))
+        return eng, elastic_lib.host_state_snapshot(state)
+
+    @pytest.fixture(scope="class")
+    def mesh4(self, devices):
+        return mesh_lib.build_mesh({"data": 4})
+
+    def test_survivors_bit_exact_joiner_cloned(self, mesh4):
+        eng, host = self._host_state(mesh4)
+        out = elastic_lib.reshard_state(host, kept_positions=[0, 2, 3],
+                                        joiner_ids=[4], seed=0)
+        leaves_in = jax.tree_util.tree_leaves(host)
+        leaves_out = jax.tree_util.tree_leaves(out)
+        for a, b in zip(leaves_in, leaves_out):
+            assert b.shape[0] == 4
+            # survivor rows verbatim, in old relative order
+            np.testing.assert_array_equal(b[:3], a[[0, 2, 3]])
+        # the joiner clones the FIRST survivor's params/moments row ...
+        p_in = jax.tree_util.tree_leaves(host.params)
+        p_out = jax.tree_util.tree_leaves(out.params)
+        for a, b in zip(p_in, p_out):
+            np.testing.assert_array_equal(b[3], a[0])
+        # ... but draws a FRESH rng stream keyed by its logical id
+        expect = np.asarray(jax.random.key_data(
+            jax.random.fold_in(jax.random.key(0), 4)))
+        np.testing.assert_array_equal(out.rng[3], expect)
+        assert not (out.rng[3] == out.rng[0]).all()
+        # ... and zero EF residual (a cloned one would double-inject the
+        # donor's accumulated quantization error)
+        for r_in, r_out in zip(
+                jax.tree_util.tree_leaves(host.sync_residual),
+                jax.tree_util.tree_leaves(out.sync_residual)):
+            np.testing.assert_array_equal(r_out[:3], r_in[[0, 2, 3]])
+            assert (r_out[3] == 0).all()
+
+    def test_no_survivors_raises(self, mesh4):
+        _, host = self._host_state(mesh4)
+        with pytest.raises(ValueError, match="no surviving"):
+            elastic_lib.reshard_state(host, kept_positions=[],
+                                      joiner_ids=[0], seed=0)
+
+
+# ----------------------------------------------------------------------
+# The elastic round loop (driver e2e, simulated N-worker CPU)
+# ----------------------------------------------------------------------
+
+def _cfg(**kw):
+    base = dict(model="mlp", dataset="mnist", epochs_global=5,
+                epochs_local=1, batch_size=16, limit_train_samples=400,
+                limit_eval_samples=100, compute_dtype="float32",
+                augment=False, aggregation_by="weights", seed=1,
+                num_workers=4)
+    base.update(kw)
+    return Config(**base)
+
+
+PROBE4 = np.array([1.0, 1.5, 1.0, 2.0])
+
+TAIL_KEYS = ("global_train_losses", "global_val_losses",
+             "global_train_accuracies", "global_val_accuracies",
+             "step_caps", "shard_sizes")
+
+
+def _assert_bitwise_tail(full, fresh, boundary: int):
+    """The fresh-from-snapshot run's whole trajectory must equal the
+    continued run's post-boundary tail EXACTLY (fp32 list equality —
+    bitwise for the float entries)."""
+    for k in TAIL_KEYS:
+        assert full[k][boundary:] == fresh[k], f"results[{k!r}] diverged"
+
+
+class TestElasticRoundLoop:
+    def test_kill_mid_run_bitwise_matches_fresh_run(self):
+        """THE acceptance gate: a worker killed at a round boundary, the
+        run continues in process, and the post-event trajectory is
+        bitwise-identical to a fresh run started from the captured
+        membership snapshot — sanitized, zero unsanctioned retraces."""
+        kw = dict(chaos="kill@2:w1", sanitize=True)
+        walls = lambda e: np.ones(4 if e < 2 else 3)
+        full = train_global(_cfg(**kw), progress=False,
+                            simulated_durations=PROBE4,
+                            simulated_round_durations=walls)
+        el = full["elastic"]
+        assert el["enabled"] and el["events"] == [
+            {"round": 2, "kind": "kill", "worker": 1}]
+        assert el["final_worker_ids"] == [0, 2, 3]
+        assert el["rounds_degraded"] == 3 and len(el["reshard_ms"]) == 1
+        assert el["reshard_ms"][0] > 0
+        assert full["sanitize"]["retrace_count"] == 0
+        assert full["sanitize"]["transfer_guard_violations"] == 0
+        # the dead worker's per-worker curve freezes at the boundary
+        assert len(full["all_workers_losses"]) == 4
+        snap = el["snapshots"][0]
+        assert (snap.epoch, snap.worker_ids) == (2, [0, 2, 3])
+        fresh = train_global(_cfg(**kw), progress=False,
+                             simulated_durations=PROBE4,
+                             simulated_round_durations=walls,
+                             elastic_snapshot=snap)
+        assert len(fresh["global_train_losses"]) == 3
+        assert fresh["sanitize"]["retrace_count"] == 0
+        _assert_bitwise_tail(full, fresh, boundary=2)
+        # per-worker curves too: survivors' tails match the fresh run
+        for wid in (0, 2, 3):
+            tail = full["all_workers_losses"][wid]
+            assert tail[-len(fresh["all_workers_losses"][wid]):] == \
+                fresh["all_workers_losses"][wid]
+
+    def test_join_mid_run_completes_in_process(self):
+        walls = lambda e: np.ones(4 if e < 2 else 5)
+        res = train_global(_cfg(chaos="join@2", epochs_global=4,
+                                sanitize=True),
+                           progress=False, simulated_durations=PROBE4,
+                           simulated_round_durations=walls)
+        el = res["elastic"]
+        assert el["events"] == [{"round": 2, "kind": "join", "worker": 4}]
+        assert el["final_worker_ids"] == [0, 1, 2, 3, 4]
+        assert el["rounds_degraded"] == 0
+        assert res["sanitize"]["retrace_count"] == 0
+        # the joiner trains from its admission round on
+        assert len(res["all_workers_losses"]) == 5
+        assert len(res["all_workers_losses"][4]) > 0
+        assert np.isfinite(res["global_train_losses"]).all()
+        # its shard was carved from the survivors' EMA-seeded share
+        assert len(res["shard_sizes"][-1]) == 5
+
+    def test_straggler_departs_after_retry_budget(self):
+        # slow@1:w3x100 makes worker 3 overrun time_limit + grace from
+        # round 1 on: round 1 = tolerated retry (backoff-extended
+        # deadline), round 2 = retries exhausted -> departs at round 3's
+        # boundary, shard redistributed — the retry/timeout/backoff
+        # protocol end to end, no scripted kill involved
+        res = train_global(
+            _cfg(chaos="slow@1:w3x100", time_limit=10.0, chaos_grace=5.0,
+                 chaos_retries=1, chaos_backoff=0.5),
+            progress=False, simulated_durations=PROBE4,
+            simulated_round_durations=lambda e: np.ones(4 if e < 3 else 3))
+        el = res["elastic"]
+        assert [r["worker"] for r in el["sync_retries"]] == [3]
+        assert el["sync_retries"][0]["attempt"] == 1
+        assert el["events"] == [{"round": 3, "kind": "depart", "worker": 3}]
+        assert el["final_worker_ids"] == [0, 1, 2]
+        assert np.isfinite(res["global_train_losses"]).all()
+
+    def test_stall_retry_then_recovery_keeps_membership(self):
+        # a one-round stall trips a retry but recovers inside the budget:
+        # nobody departs, the attempt counter resets
+        res = train_global(
+            _cfg(chaos="stall@1:w2+100", epochs_global=4, time_limit=10.0,
+                 chaos_grace=5.0, chaos_retries=1, chaos_backoff=0.5),
+            progress=False, simulated_durations=PROBE4,
+            simulated_round_durations=lambda e: np.ones(4))
+        el = res["elastic"]
+        assert [r["worker"] for r in el["sync_retries"]] == [2]
+        assert el["events"] == [] and el["final_worker_ids"] == [0, 1, 2, 3]
+        assert el["reshard_ms"] == []
+
+    def test_quorum_floor_degrades_gracefully(self):
+        # killing below --elastic_min_workers is rejected + recorded; the
+        # surviving quorum keeps training with no membership change
+        res = train_global(
+            _cfg(chaos="kill@1:w0,kill@1:w1,kill@1:w2,kill@1:w3",
+                 elastic_min_workers=2, epochs_global=3),
+            progress=False, simulated_durations=PROBE4,
+            simulated_round_durations=lambda e: np.ones(4 if e < 1 else 2))
+        el = res["elastic"]
+        assert len(el["events"]) == 2 and len(el["rejected"]) == 2
+        assert all("quorum" in r["reason"] for r in el["rejected"])
+        assert el["final_worker_ids"] == [2, 3]
+        assert np.isfinite(res["global_train_losses"]).all()
+
+
+@pytest.mark.slow
+class TestElasticSlow:
+    def test_join_bitwise_matches_fresh_run(self):
+        kw = dict(chaos="join@2", sanitize=True)
+        walls = lambda e: np.ones(4 if e < 2 else 5)
+        full = train_global(_cfg(**kw), progress=False,
+                            simulated_durations=PROBE4,
+                            simulated_round_durations=walls)
+        snap = full["elastic"]["snapshots"][0]
+        assert snap.worker_ids == [0, 1, 2, 3, 4]
+        fresh = train_global(_cfg(**kw), progress=False,
+                             simulated_durations=PROBE4,
+                             simulated_round_durations=walls,
+                             elastic_snapshot=snap)
+        _assert_bitwise_tail(full, fresh, boundary=2)
+        assert full["all_workers_losses"][4] == \
+            fresh["all_workers_losses"][4]
+
+    def test_kill_max_id_then_join_bitwise_matches_fresh_run(self):
+        # regression (code review): the snapshot carries the plan's id
+        # allocator position.  Killing the MAX-id worker before the
+        # join means a fresh-twin run recomputing next_id as max+1
+        # would recycle id 3 for the joiner — a different RNG stream,
+        # bitwise-diverging from the continued run (which hands out 4).
+        kw = dict(chaos="kill@1:w3,join@3", sanitize=True)
+        walls = lambda e: np.ones(4 if e < 1 else (3 if e < 3 else 4))
+        full = train_global(_cfg(**kw), progress=False,
+                            simulated_durations=PROBE4,
+                            simulated_round_durations=walls)
+        el = full["elastic"]
+        assert el["final_worker_ids"] == [0, 1, 2, 4]   # 3 not recycled
+        snap = el["snapshots"][0]            # post-kill boundary
+        assert snap.next_worker_id == 4
+        fresh = train_global(_cfg(**kw), progress=False,
+                             simulated_durations=PROBE4,
+                             simulated_round_durations=walls,
+                             elastic_snapshot=snap)
+        assert fresh["elastic"]["final_worker_ids"] == [0, 1, 2, 4]
+        _assert_bitwise_tail(full, fresh, boundary=1)
+
+    @pytest.mark.parametrize("topology", ["ring", "double_ring"])
+    def test_gossip_topologies_kill_and_join(self, topology):
+        # the dangerous case for rings: a departed worker must never
+        # strand a ppermute neighbor — the rebuilt engine re-derives the
+        # neighbor tables from the new axis size.  Full bitwise gate per
+        # topology.
+        kw = dict(chaos="kill@1:w2,join@2", topology=topology,
+                  epochs_global=4)
+        walls = lambda e: np.ones(4 if e < 1 else (3 if e < 2 else 4))
+        full = train_global(_cfg(**kw), progress=False,
+                            simulated_durations=PROBE4,
+                            simulated_round_durations=walls)
+        el = full["elastic"]
+        assert el["final_worker_ids"] == [0, 1, 3, 4]
+        assert np.isfinite(full["global_train_losses"]).all()
+        snap = el["snapshots"][1]       # post-join boundary (round 2)
+        fresh = train_global(_cfg(**kw), progress=False,
+                             simulated_durations=PROBE4,
+                             simulated_round_durations=walls,
+                             elastic_snapshot=snap)
+        _assert_bitwise_tail(full, fresh, boundary=2)
+
+    def test_crash_during_reshard_resumes_and_replays(self, tmp_path,
+                                                      monkeypatch):
+        # the recovery story: a crash INSIDE the membership transition
+        # (after the old state is snapshotted, before the new engine
+        # exists) resumes from the last committed checkpoint and REPLAYS
+        # the deterministic chaos schedule — the event re-applies at the
+        # same boundary and the run completes without the crashed
+        # process's in-memory state
+        kw = dict(chaos="kill@2:w1", epochs_global=3,
+                  checkpoint_dir=str(tmp_path), checkpoint_every=1)
+        walls = lambda e: np.ones(4 if e < 2 else 3)
+        run = lambda **o: train_global(
+            _cfg(**kw, **o), progress=False, simulated_durations=PROBE4,
+            simulated_round_durations=walls)
+        monkeypatch.setenv("JAX_GRAFT_ELASTIC_TEST_CRASH", "mid_reshard")
+        with pytest.raises(RuntimeError, match="elastic test crash hook"):
+            run()
+        monkeypatch.delenv("JAX_GRAFT_ELASTIC_TEST_CRASH")
+        # snapshot the post-crash checkpoint dir so the recovery can run
+        # twice from the identical on-disk state (the first resume
+        # appends its own epoch-3 checkpoint)
+        import shutil
+        twin_dir = str(tmp_path) + "_twin"
+        shutil.copytree(str(tmp_path), twin_dir)
+        resumed = run(resume=True)
+        el = resumed["elastic"]
+        assert el["events"] == [{"round": 2, "kind": "kill", "worker": 1}]
+        assert el["final_worker_ids"] == [0, 2, 3]
+        # exactly the post-crash round ran (rounds 0-1 are committed;
+        # the kill@2 boundary event re-applies on replay, NOT skipped)
+        assert len(resumed["global_train_losses"]) == 1
+        assert np.isfinite(resumed["global_train_losses"]).all()
+        assert len(el["reshard_ms"]) == 1
+        # the recovery is deterministic: a second resume from the same
+        # on-disk state replays the schedule to a bitwise-identical tail
+        # (host-side loop state — wall EMA, partition rng — recomputes
+        # from the probe on ANY resume, so the uninterrupted run is not
+        # the comparison point; the snapshot gate above covers that)
+        again = train_global(
+            _cfg(**{**kw, "checkpoint_dir": twin_dir}, resume=True),
+            progress=False, simulated_durations=PROBE4,
+            simulated_round_durations=walls)
+        assert again["global_train_losses"] == \
+            resumed["global_train_losses"]
+        assert again["elastic"]["final_worker_ids"] == [0, 2, 3]
+
+    def test_resume_across_earlier_membership_events_refused(
+            self, tmp_path):
+        kw = dict(chaos="kill@1:w1", epochs_global=3,
+                  checkpoint_dir=str(tmp_path), checkpoint_every=1)
+        walls = lambda e: np.ones(4 if e < 1 else 3)
+        train_global(_cfg(**kw), progress=False,
+                     simulated_durations=PROBE4,
+                     simulated_round_durations=walls)
+        with pytest.raises(ValueError, match="membership events"):
+            train_global(_cfg(**{**kw, "epochs_global": 4}, resume=True),
+                         progress=False, simulated_durations=PROBE4,
+                         simulated_round_durations=walls)
+
+    def test_resume_across_straggler_departure_refused(self, tmp_path):
+        # a STRAGGLER-protocol departure never appears in the --chaos
+        # schedule, so the scripted-event scan can't see it — the
+        # manifest's recorded worker axis must refuse the resume with
+        # the real reason instead of restore's opaque shape mismatch
+        kw = dict(chaos="slow@1:w3x100", time_limit=10.0, chaos_grace=5.0,
+                  chaos_retries=0, epochs_global=3,
+                  checkpoint_dir=str(tmp_path), checkpoint_every=1)
+        walls = lambda e: (np.ones(4) if e < 2 else np.ones(3))
+        res = train_global(_cfg(**kw), progress=False,
+                           simulated_durations=PROBE4,
+                           simulated_round_durations=walls)
+        assert res["elastic"]["final_worker_ids"] == [0, 1, 2]  # departed
+        with pytest.raises(ValueError, match="worker"):
+            train_global(_cfg(**{**kw, "epochs_global": 4}, resume=True),
+                         progress=False, simulated_durations=PROBE4,
+                         simulated_round_durations=walls)
+
+    def test_random_chaos_run_completes(self):
+        # seeded-random schedule: whatever the draw, the run must finish
+        # on the surviving quorum with finite losses and consistent
+        # telemetry (quorum floor 2 keeps kills survivable)
+        res = train_global(
+            _cfg(chaos="random", chaos_seed=11, chaos_events=4,
+                 elastic_min_workers=2, epochs_global=5, time_limit=10.0),
+            progress=False, simulated_durations=PROBE4)
+        el = res["elastic"]
+        assert el["enabled"]
+        assert len(el["events"]) + len(el["rejected"]) >= 0
+        assert len(el["final_worker_ids"]) >= 2
+        assert np.isfinite(res["global_train_losses"]).all()
+        assert len(res["global_train_losses"]) == 5
+
+    def test_disbalanced_mode_kill_completes(self):
+        # the skew re-draw path: fixed classes follow LOGICAL ids and the
+        # partition re-draws from the post-event roster
+        walls = lambda e: np.ones(4 if e < 2 else 3)
+        res = train_global(
+            _cfg(chaos="kill@2:w1", data_mode="disbalanced",
+                 epochs_global=4),
+            progress=False, simulated_durations=PROBE4,
+            simulated_round_durations=walls)
+        assert res["elastic"]["final_worker_ids"] == [0, 2, 3]
+        assert np.isfinite(res["global_train_losses"]).all()

@@ -263,6 +263,34 @@ class TestStops:
         stop = stream.index(eos)
         assert c.reason == "eos" and c.tokens == stream[:stop + 1]
 
+    def test_request_timeout_evicts_stuck_sequence(self, served):
+        # ISSUE 8 satellite: a sequence decoding past its wall-clock
+        # budget is evicted (reason "timeout", counted in timed_out)
+        # instead of pinning its slot + pages forever — and the freed
+        # capacity admits the queue behind it (max_batch=1 forces the
+        # second request to ride the eviction)
+        model, v = served("gpt")
+        eng = _engine(model, v, max_batch=1)
+        out = ContinuousBatchingScheduler(
+            eng, eos_id=-1, request_timeout=1e-6).run(
+                [Request(rid=0, prompt=PROMPT[:4], max_new_tokens=8),
+                 Request(rid=1, prompt=PROMPT[:4], max_new_tokens=8)])
+        assert out["timed_out"] == 2 and out["evicted"] == 2
+        for rid in (0, 1):
+            c = out["completions"][rid]
+            assert c.reason == "timeout"
+            assert len(c.tokens) < 8     # cut off before its budget
+        assert out["pages"]["leaked"] == 0
+        assert eng.allocator.in_use == 0  # everything freed on eviction
+
+    def test_request_timeout_off_by_default(self, served):
+        model, v = served("gpt")
+        out = ContinuousBatchingScheduler(
+            _engine(model, v), eos_id=-1).run(
+                [Request(rid=0, prompt=PROMPT[:4], max_new_tokens=3)])
+        assert out["timed_out"] == 0
+        assert out["completions"][0].reason == "length"
+
 
 # ----------------------------------------------------------------------
 # Two compiled programs: zero retraces after warmup

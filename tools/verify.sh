@@ -146,6 +146,37 @@ EOF
     echo "serve smoke assertions FAILED (rc=$src)"
     exit "$src"
   fi
+
+  # seconds-scale elastic-membership smoke (ISSUE 8): the --entry elastic
+  # A/B (steady-state run vs the identical run with one scripted mid-run
+  # kill and one join) must apply both events, keep the per-event reshard
+  # stall bounded (< 10 POST-WARMUP steady rounds — the honest
+  # denominator excludes round 0's compile; measured ~3-4x on the tiny
+  # 120 ms-round CPU config, and the stall is amortized: a restart pays
+  # probe + full recompile instead), and — the ROADMAP's elastic gate —
+  # replay the post-kill tail bitwise (fp32) from the captured
+  # membership snapshot.
+  echo "== bench smoke: elastic membership entry (CPU, 4 workers) =="
+  ELASTIC_JSON=$(XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+    JAX_PLATFORMS=cpu BENCH_BUDGET_S="${BENCH_BUDGET_S:-240}" \
+    python bench.py --entry elastic) || { echo "elastic smoke FAILED"; exit 1; }
+  echo "$ELASTIC_JSON"
+  python - "$ELASTIC_JSON" <<'EOF'
+import json, sys
+out = json.loads(sys.argv[1])
+if out.get("status") == "budget_backstop":
+    sys.exit(0)  # slow host: the backstop line is the accepted outcome
+assert out["events"] == ["kill", "join"], out["events"]
+assert out["bitwise_tail_from_snapshot"] is True
+for ratio in out["stall_vs_steady_round"]:
+    assert ratio is not None and ratio < 10.0, out["stall_vs_steady_round"]
+print("elastic smoke OK")
+EOF
+  erc=$?
+  if [ "$erc" -ne 0 ]; then
+    echo "elastic smoke assertions FAILED (rc=$erc)"
+    exit "$erc"
+  fi
 fi
 
 # Checkpoint kill-mid-write -> resume smoke (ISSUE 5 satellite): phase A
@@ -254,6 +285,43 @@ if ! grep -q "sanitizer clean" "$SAN_OUT"; then
 fi
 rm -rf "$SAN_DIR"
 echo "sanitize smoke OK"
+
+# Chaos/elastic smoke (ISSUE 8): a 2-round sanitized CPU driver run on 4
+# simulated workers with one scripted kill AND one join at the round-1
+# boundary — the membership change resizes the mesh, re-buckets the sync
+# engine, and restages the row-edited state in process.  Gate: rc 0, the
+# elastic provenance line shows 2 applied events, and the all-zero
+# sanitizer row SURVIVES the reshard ("sanitizer clean" — the new round
+# program's recompile is the one sanctioned exception; anything else
+# raises and fails the run).
+echo "== chaos smoke (CLI --chaos kill+join, sanitized 2-round driver) =="
+CHAOS_DIR=$(mktemp -d)
+CHAOS_OUT="$CHAOS_DIR/out.log"
+if ! XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+    JAX_PLATFORMS=cpu python -m \
+    learning_deep_neural_network_in_distributed_computing_environment_tpu.main \
+    --sanitize --chaos "kill@1:w1,join@1" --device cpu \
+    --model mlp --dataset mnist --num_workers 4 \
+    --epochs_global 2 --epochs_local 1 --batch_size 16 \
+    --limit_train_samples 512 --limit_eval_samples 64 \
+    --compute_dtype float32 --no_augment --aggregation_by weights \
+    --seed 7 --out_dir "$CHAOS_DIR/graphs" \
+    >"$CHAOS_OUT" 2>&1; then
+  echo "chaos smoke FAILED:"; tail -40 "$CHAOS_OUT"
+  rm -rf "$CHAOS_DIR"; exit 1
+fi
+if ! grep -q "elastic: 2 membership event(s)" "$CHAOS_OUT"; then
+  echo "chaos smoke: run exited 0 but the kill+join membership events"
+  echo "were not applied (no elastic provenance line):"
+  tail -40 "$CHAOS_OUT"; rm -rf "$CHAOS_DIR"; exit 1
+fi
+if ! grep -q "sanitizer clean" "$CHAOS_OUT"; then
+  echo "chaos smoke: membership change applied but the all-zero"
+  echo "sanitizer row did not survive the reshard:"
+  tail -40 "$CHAOS_OUT"; rm -rf "$CHAOS_DIR"; exit 1
+fi
+rm -rf "$CHAOS_DIR"
+echo "chaos smoke OK"
 
 # Serving smoke (ISSUE 7): train 2 rounds of gpt_tiny with per-round
 # checkpoints, then `main.py serve` decodes a fixed prompt GREEDILY off
