@@ -593,6 +593,17 @@ def measure_sync() -> dict:
     compressed halves that again (bf16 wire).  Also asserts the fp32
     sharded result is BIT-IDENTICAL to dense and reports the compressed
     path's max deviation.
+
+    The ``opt_placement`` axis (ISSUE 9) A/Bs the shard-resident
+    optimizer: the same sync program with the round-optimizer Adam
+    moment tracker under the replicated layout (every worker stores and
+    updates the full [padded] moment vector — N identical copies) vs
+    the sharded layout (each worker stores/updates only its 1/N bucket
+    shard).  Reports per-worker opt-state bytes (sharded must be exactly
+    1/N of replicated), the apply+sync wall of each placement, and the
+    bitwise gates: the synced tree is placement-invariant and the
+    sharded tracker rows are the exact row-partition of the replicated
+    vector.
     """
     import jax
     import jax.numpy as jnp
@@ -625,6 +636,38 @@ def measure_sync() -> dict:
         float(np.abs(np.asarray(comp_out[k], np.float32)
                      - np.asarray(dense_out[k], np.float32)).max())
         for k in shapes)
+
+    # --- optimizer-placement axis (ISSUE 9) ---------------------------
+    placement_rows: dict = {}
+    placed_out: dict = {}
+    trackers: dict = {}
+    for pl in ("replicated", "sharded"):
+        trk0 = comms.round_opt_init(per_worker, n, placement=pl)
+        opt_bytes = sum(int(np.prod(l.shape)) * 4 // n
+                        for l in jax.tree_util.tree_leaves(trk0))
+        fn = comms.make_host_sync(mesh, mode="sharded", opt_placement=pl,
+                                  track_opt=True)
+        (p_out, _r, trk1), wall = _time_host_sync(
+            lambda t, r, _f=fn, _k=trk0: _f(t, r, _k), tree, None,
+            reps=3)
+        placed_out[pl], trackers[pl] = p_out, jax.device_get(trk1)
+        placement_rows[pl] = {"ms": round(wall * 1e3, 3),
+                              "opt_state_mb_per_worker":
+                                  round(opt_bytes / 1e6, 4)}
+    tracker_ok = all(
+        np.array_equal(np.asarray(trackers["sharded"][b][m]).reshape(-1),
+                       np.asarray(trackers["replicated"][b][m])[0])
+        for b in trackers["sharded"] for m in ("mu", "nu"))
+    placement_rows["opt_state_bytes_ratio"] = round(
+        placement_rows["sharded"]["opt_state_mb_per_worker"]
+        / placement_rows["replicated"]["opt_state_mb_per_worker"], 4)
+    placement_rows["expected_opt_state_ratio"] = round(1 / n, 4)
+    placement_rows["bitwise_sharded_eq_replicated"] = bool(all(
+        np.array_equal(np.asarray(placed_out["replicated"][k]),
+                       np.asarray(placed_out["sharded"][k]))
+        for k in shapes))
+    placement_rows["tracker_bitwise_consistent"] = bool(tracker_ok)
+
     return {
         "n_workers": n,
         "param_mb": round(4 * elems / 1e6, 2),
@@ -635,6 +678,7 @@ def measure_sync() -> dict:
         "expected_bytes_ratio": round(2 * (n - 1) / n, 4),
         "bitwise_sharded_eq_dense": bool(bitwise),
         "compressed_max_abs_err": max_err,
+        "opt_placement": placement_rows,
     }
 
 

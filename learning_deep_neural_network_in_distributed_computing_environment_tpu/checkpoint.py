@@ -631,11 +631,31 @@ def restore_checkpoint(path: str, state_template):
         leaves = []
         for kpath, tmpl in flat:
             key = jax.tree_util.keystr(kpath)
+            is_round_opt = key.startswith(".round_opt")
             if key not in merged:
+                if is_round_opt:
+                    # pre-ISSUE-9 checkpoint (or one saved without the
+                    # tracker) restored into a tracker-armed run: fresh
+                    # zero moments, exactly like a fresh engine init
+                    log.warning(
+                        "checkpoint %s has no round-optimizer leaf %s — "
+                        "restoring zero moments", path, key)
+                    leaves.append(_reshard_leaf(
+                        tmpl, np.zeros(np.shape(tmpl),
+                                       np.dtype(tmpl.dtype))))
+                    continue
                 raise ValueError(
                     f"checkpoint {path} has no leaf {key} required by the "
                     "restore template (engine config mismatch?)")
             val = merged[key]
+            if (is_round_opt
+                    and tuple(val.shape) != tuple(np.shape(tmpl))):
+                # cross-placement restore (ISSUE 9 satellite): the saved
+                # moment rows are either worker-axis shards of one
+                # vector ([N, P/N], --opt_placement sharded) or N
+                # identical replicas ([N, P]); both reconstruct the same
+                # vector, so the re-layout is exact in either direction
+                val = _relayout_round_opt(key, val, np.shape(tmpl))
             if tuple(val.shape) != tuple(np.shape(tmpl)):
                 raise ValueError(
                     f"checkpoint leaf {key} shape {val.shape} does not "
@@ -655,6 +675,32 @@ def restore_checkpoint(path: str, state_template):
         {"state": state_template, "global_epoch": 0}, data)
     state = jax.tree.map(_reshard_leaf, state_template, payload["state"])
     return state, int(payload["global_epoch"])
+
+
+def _relayout_round_opt(key: str, val: np.ndarray,
+                        tmpl_shape) -> np.ndarray:
+    """Convert one round-optimizer leaf between the sharded ([N, P/N]
+    worker-axis shard rows) and replicated ([N, P] identical rows)
+    layouts (ISSUE 9).  The tracked vector is worker-invariant, so both
+    directions are exact: sharded -> replicated concatenates the shard
+    rows back into the vector and replicates it; replicated -> sharded
+    row-partitions any replica.  The worker count itself must match (the
+    other TrainState leaves enforce that first)."""
+    n, p = int(val.shape[0]), int(val.shape[1]) if val.ndim == 2 else -1
+    want = tuple(int(d) for d in tmpl_shape)
+    if val.ndim != 2 or len(want) != 2 or want[0] != n:
+        raise ValueError(
+            f"checkpoint round-optimizer leaf {key} shape "
+            f"{tuple(val.shape)} cannot re-layout to template {want}")
+    if want[1] == n * p:         # sharded on disk -> replicated template
+        return np.broadcast_to(val.reshape(-1), want).copy()
+    if p == n * want[1]:         # replicated on disk -> sharded template
+        return np.ascontiguousarray(val[0].reshape(n, want[1]))
+    raise ValueError(
+        f"checkpoint round-optimizer leaf {key} shape "
+        f"{tuple(val.shape)} matches neither the sharded nor the "
+        f"replicated layout of template {want} (different "
+        "--sync_bucket_mb or worker count?)")
 
 
 def _reshard_leaf(tmpl, val):

@@ -249,7 +249,8 @@ def sync_wire_bytes(tree: PyTree, n: int, *, mode: str = "sharded",
 def sharded_sync(tree: PyTree, *, how: str = "equal",
                  local_weight: float = 0.5, axis_name: str = DATA_AXIS,
                  wire_dtype=None, residual: PyTree | None = None,
-                 bucket_bytes: int = DEFAULT_BUCKET_BYTES
+                 bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+                 opt_placement: str = "sharded"
                  ) -> tuple[PyTree, PyTree | None]:
     """Sharded all-reduce aggregation of a per-worker pytree.
 
@@ -274,13 +275,142 @@ def sharded_sync(tree: PyTree, *, how: str = "equal",
     Returns ``(synced_tree, new_residual)`` — ``new_residual`` is
     ``residual`` unchanged (possibly None) when no error feedback is
     active.
+
+    ``opt_placement`` places the apply stage (the blend scaling between
+    the two collective phases — ISSUE 9): ``"sharded"`` scales on the
+    1/N psum_scatter shard so only post-update values ride the
+    all_gather; ``"replicated"`` gathers the raw shard sums and scales
+    the full buffer on every worker — the ZeRO-1 paper's A/B twin,
+    bit-identical in fp32 (elementwise scaling commutes with the gather
+    bit-for-bit).  Compressed wires require the sharded placement: the
+    gathered payload IS the encoded mean, so the scale must run before
+    the encode on the shard (config.py validates).
     """
+    synced, new_res, _ = sharded_opt_sync(
+        tree, how=how, local_weight=local_weight, axis_name=axis_name,
+        wire_dtype=wire_dtype, residual=residual,
+        bucket_bytes=bucket_bytes, opt_placement=opt_placement)
+    return synced, new_res
+
+
+# Round-optimizer tracker (ISSUE 9): torch.optim.Adam moment defaults,
+# matching the engine's per-batch Adam (train.py scale_by_adam betas).
+ROUND_ADAM_B1 = 0.9
+ROUND_ADAM_B2 = 0.999
+
+OPT_PLACEMENTS = ("replicated", "sharded")
+
+
+def _bucket_name(i: int) -> str:
+    return f"b{i:04d}"
+
+
+def round_opt_init(per_worker_tree: PyTree, n: int, *, placement: str,
+                   bucket_bytes: int = DEFAULT_BUCKET_BYTES) -> dict:
+    """Zero-initialized round-optimizer moments for ``per_worker_tree``
+    (leaves may be arrays or ShapeDtypeStructs — per-worker shapes, no
+    worker axis), worker-STACKED for the engine state.
+
+    Layout per bucket of the sync engine's plan: ``sharded`` stores each
+    worker's OWN 1/N shard row — ``[n, padded // n]`` — so per-worker
+    resident bytes are 1/N of the moment vector; ``replicated`` stores
+    the full padded vector on every worker — ``[n, padded]`` — the
+    N-copies baseline the ZeRO-1 scheme removes.  Both track the same
+    worker-invariant quantity (Adam moments of the cross-worker mean of
+    the aggregated tree), so rows of the replicated layout are
+    identical and the sharded layout is its exact row-partition
+    (bitwise-gated in tests/test_opt_placement.py)."""
+    if placement not in OPT_PLACEMENTS:
+        raise ValueError(
+            f"placement must be one of {OPT_PLACEMENTS}, got {placement!r}")
+    leaves = jax.tree_util.tree_leaves(per_worker_tree)
+    out: dict = {}
+    for i, b in enumerate(bucket_plan(leaves, n, bucket_bytes)):
+        row = b.padded // n if placement == "sharded" else b.padded
+        out[_bucket_name(i)] = {
+            "mu": jnp.zeros((n, row), jnp.float32),
+            "nu": jnp.zeros((n, row), jnp.float32)}
+    return out
+
+
+def round_opt_relayout(tracker: dict, per_worker_tree: PyTree, n_new: int,
+                       *, placement: str,
+                       bucket_bytes: int = DEFAULT_BUCKET_BYTES) -> dict:
+    """Re-layout a HOST round-optimizer tracker for a new worker count
+    (elastic membership change, ISSUE 9 satellite).
+
+    The tracked quantity is worker-invariant, so a membership change
+    never edits rows the way per-worker state does: the moment VECTOR
+    is reconstructed (concatenate the shard rows / take the replicated
+    row), re-padded for the new bucket tiling (padding positions carry
+    exactly-zero moments — the padded mean is zero every round — so
+    trimming or extending the pad is exact), and re-split.  ``tracker``
+    layout must match ``placement``; returns numpy arrays."""
+    import numpy as np
+
+    leaves = jax.tree_util.tree_leaves(per_worker_tree)
+    plan = bucket_plan(leaves, max(1, n_new), bucket_bytes)
+    out: dict = {}
+    for i, b in enumerate(plan):
+        name = _bucket_name(i)
+        if name not in tracker:
+            raise ValueError(
+                f"round-optimizer tracker has no bucket {name} "
+                f"({len(tracker)} buckets vs plan {len(plan)})")
+        filled = sum(size for (_i, _off, size) in b.items)
+        row_new = b.padded // n_new if placement == "sharded" else b.padded
+        out[name] = {}
+        for m in ("mu", "nu"):
+            arr = np.asarray(tracker[name][m])
+            vec = (arr.reshape(-1) if placement == "sharded"
+                   else arr[0])
+            if vec.size < filled:
+                raise ValueError(
+                    f"round-optimizer bucket {name}/{m} carries "
+                    f"{vec.size} elements but the plan needs {filled}")
+            vec = vec[:filled]
+            pad = (n_new * row_new if placement == "sharded"
+                   else b.padded) - filled
+            if pad:
+                vec = np.concatenate([vec, np.zeros(pad, vec.dtype)])
+            if placement == "sharded":
+                out[name][m] = vec.reshape(n_new, row_new)
+            else:
+                out[name][m] = np.broadcast_to(
+                    vec, (n_new, b.padded)).copy()
+    return out
+
+
+def sharded_opt_sync(tree: PyTree, *, how: str = "equal",
+                     local_weight: float = 0.5, axis_name: str = DATA_AXIS,
+                     wire_dtype=None, residual: PyTree | None = None,
+                     bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+                     opt_placement: str = "sharded",
+                     tracker: dict | None = None
+                     ) -> tuple[PyTree, PyTree | None, dict | None]:
+    """``sharded_sync`` with the full apply-stage surface (ISSUE 9):
+    optimizer placement plus the round-level Adam moment tracker.
+
+    ``tracker`` (per-worker slices of a ``round_opt_init`` tree, i.e.
+    already squeezed inside shard_map) updates Adam moments of the
+    CROSS-WORKER MEAN of ``tree`` — the worker-invariant aggregated
+    quantity, which is what makes the moments shardable at all.  Under
+    ``opt_placement="sharded"`` each worker updates only the moment
+    slice of the bucket shard it owns (1/N state, 1/N FLOPs); under
+    ``"replicated"`` every worker updates the full vector from the
+    gathered sums — N identical copies of the same arithmetic, kept as
+    the bitwise A/B twin.  Returns
+    ``(synced, new_residual, new_tracker)``."""
     if how not in HOWS:
         raise ValueError(f"how must be one of {HOWS}, got {how!r}")
+    if opt_placement not in OPT_PLACEMENTS:
+        raise ValueError(
+            f"opt_placement must be one of {OPT_PLACEMENTS}, got "
+            f"{opt_placement!r}")
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     n = axis_size(axis_name)
     if not leaves or n == 1:
-        return tree, residual
+        return tree, residual, tracker
     res_leaves = None
     if residual is not None:
         res_leaves = jax.tree_util.tree_leaves(residual)
@@ -288,11 +418,19 @@ def sharded_sync(tree: PyTree, *, how: str = "equal",
             raise ValueError(
                 "residual must mirror the synced tree: "
                 f"{len(res_leaves)} leaves vs {len(leaves)}")
+    compressed_wire = (wire_dtype is not None
+                       and jnp.dtype(wire_dtype) != jnp.dtype(jnp.float32))
+    if compressed_wire and opt_placement != "sharded":
+        raise ValueError(
+            "a compressed wire quantizes the gathered mean, which forces "
+            "the scale-then-encode apply onto the shard: opt_placement "
+            f"must be 'sharded', got {opt_placement!r}")
+    new_tracker: dict | None = {} if tracker is not None else None
     out: list = [None] * len(leaves)
     new_res: list | None = [None] * len(leaves) if res_leaves is not None \
         else None
     w = local_weight
-    for b in bucket_plan(leaves, n, bucket_bytes):
+    for bi, b in enumerate(bucket_plan(leaves, n, bucket_bytes)):
         parts, filled = [], 0
         for (i, _off, size) in b.items:
             x = leaves[i].astype(jnp.float32).reshape(-1)
@@ -345,31 +483,77 @@ def sharded_sync(tree: PyTree, *, how: str = "equal",
         else:
             shard32 = psum_scatter(sent, axis_name, scatter_dimension=0,
                                    tiled=True).astype(jnp.float32)
+        track32 = None   # fp32 mean the round-optimizer tracker consumes
         if how == "equal":
-            mean32 = shard32 / n
-            mean, mean32_dec, mean_scale = encode(mean32)
-            if new_res is not None and compressed:
-                # second-stage error feedback: the gathered mean is ALSO
-                # wire-quantized, and that rounding recurs every round on
-                # the same grid (sub-quantum drift of the mean would stall
-                # without it).  The shard's owner folds n x the rounding
-                # error into its own residual at the shard's positions —
-                # next round's mean divides the n back out, delivering
-                # the correction one round delayed.
-                e2 = mean32 - mean32_dec
-                err = err + lax.dynamic_update_slice(
-                    jnp.zeros((b.padded,), jnp.float32), n * e2,
-                    (lax.axis_index(axis_name) * (b.padded // n),))
-            full = gather_decoded(mean, mean_scale)
+            if opt_placement == "replicated" and not compressed:
+                # replicated apply (the ZeRO-1 paper's baseline, kept as
+                # the A/B twin): gather the RAW shard sums and scale the
+                # full buffer on EVERY worker — N copies of the same
+                # arithmetic.  Elementwise scaling commutes with the
+                # gather bit-for-bit, so the result is bitwise-identical
+                # to the shard-resident apply below.
+                full = lax.all_gather(shard32, axis_name,
+                                      tiled=True).astype(jnp.float32) / n
+                track32 = full
+            else:
+                # shard-resident apply: the scale (and, compressed, the
+                # mean's wire encode + stage-2 EF) runs on the 1/N shard;
+                # only the post-update values ride the all_gather home
+                mean32 = shard32 / n
+                mean, mean32_dec, mean_scale = encode(mean32)
+                if new_res is not None and compressed:
+                    # second-stage error feedback: the gathered mean is
+                    # ALSO wire-quantized, and that rounding recurs every
+                    # round on the same grid (sub-quantum drift of the
+                    # mean would stall without it).  The shard's owner
+                    # folds n x the rounding error into its own residual
+                    # at the shard's positions — next round's mean
+                    # divides the n back out, delivering the correction
+                    # one round delayed.
+                    e2 = mean32 - mean32_dec
+                    err = err + lax.dynamic_update_slice(
+                        jnp.zeros((b.padded,), jnp.float32), n * e2,
+                        (lax.axis_index(axis_name) * (b.padded // n),))
+                full = gather_decoded(mean, mean_scale)
+                track32 = mean32
         else:
             # weighted needs the per-worker OWN value elementwise, so the
             # gather redistributes the raw sum and the blend runs locally;
             # own is the compressed own contribution — the value the peers
-            # actually received
+            # actually received.  The own-blend is irreducibly per-worker
+            # (each worker's output is a different function of its own
+            # value) and stays replicated under BOTH placements — the
+            # shardable part of the weighted apply is the reduction and
+            # the tracker's mean scale (docs/ARCHITECTURE.md).
             tq, _tq32, tq_scale = encode(shard32)
             total = gather_decoded(tq, tq_scale)
             own = sent32
             full = w * own + (1.0 - w) * (total - own) / (n - 1)
+            track32 = (shard32 / n if opt_placement == "sharded"
+                       else total / n)
+        if new_tracker is not None:
+            # round-level Adam moments of the cross-worker mean — the
+            # worker-invariant quantity whose state the sharded placement
+            # stores at 1/N per worker (the replicated layout updates the
+            # identical full vector N times over)
+            name = _bucket_name(bi)
+            if name not in tracker:
+                raise ValueError(
+                    f"round-optimizer tracker has no bucket {name} "
+                    f"(bucket plan / tracker layout mismatch)")
+            mu, nu = tracker[name]["mu"], tracker[name]["nu"]
+            expect = b.padded // n if opt_placement == "sharded" \
+                else b.padded
+            if mu.shape[-1] != expect:
+                raise ValueError(
+                    f"round-optimizer bucket {name} row has "
+                    f"{mu.shape[-1]} elements, expected {expect} for "
+                    f"opt_placement={opt_placement!r} (sync_bucket_mb "
+                    "or placement changed since the state was built?)")
+            g = track32
+            new_tracker[name] = {
+                "mu": ROUND_ADAM_B1 * mu + (1.0 - ROUND_ADAM_B1) * g,
+                "nu": ROUND_ADAM_B2 * nu + (1.0 - ROUND_ADAM_B2) * (g * g)}
         for (i, off, size) in b.items:
             leaf = leaves[i]
             out[i] = full[off:off + size].reshape(leaf.shape).astype(
@@ -377,9 +561,9 @@ def sharded_sync(tree: PyTree, *, how: str = "equal",
             if new_res is not None:
                 new_res[i] = err[off:off + size].reshape(leaf.shape)
     synced = jax.tree_util.tree_unflatten(treedef, out)
-    if new_res is None:
-        return synced, residual
-    return synced, jax.tree_util.tree_unflatten(treedef, new_res)
+    res_out = (residual if new_res is None
+               else jax.tree_util.tree_unflatten(treedef, new_res))
+    return synced, res_out, new_tracker
 
 
 # --------------------------------------------------------------------------
@@ -529,7 +713,9 @@ def gossip_sync(tree: PyTree, *, topology: str, how: str = "equal",
 def make_host_sync(mesh, *, mode: str = "sharded", how: str = "equal",
                    local_weight: float = 0.5, wire_dtype=None,
                    bucket_bytes: int = DEFAULT_BUCKET_BYTES,
-                   topology: str = "allreduce"):
+                   topology: str = "allreduce",
+                   opt_placement: str = "sharded",
+                   track_opt: bool = False):
     """Jitted stand-alone round sync over worker-stacked pytrees.
 
     The sync-engine twin of ``make_host_aggregator`` (tests, bench A/Bs,
@@ -541,16 +727,26 @@ def make_host_sync(mesh, *, mode: str = "sharded", how: str = "equal",
     ``mode="gossip"`` runs the bucketed gossip engine for ring /
     double_ring; ``mode="sharded"`` the reduce-scatter engine
     (allreduce).
+
+    ``opt_placement`` places the sharded engine's apply stage (ISSUE 9,
+    ``sharded_sync``); ``track_opt=True`` additionally threads a
+    round-optimizer tracker (``round_opt_init`` layout, worker-stacked)
+    through the program — the returned callable then takes
+    ``(tree, residual, tracker)`` and returns
+    ``(synced, new_residual, new_tracker)``.
     """
     from jax.sharding import PartitionSpec as P
 
     spec = P(DATA_AXIS)
 
-    def _sync(tree, residual):
-        def inner(shard, res):
+    def _sync(tree, residual, tracker):
+        def inner(shard, res, trk):
             sq = lambda t: jax.tree_util.tree_map(lambda x: x[0], t)
             ex = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
-            t, r = sq(shard), sq(res)
+            # squeeze the tracker too: the dense/gossip branches pass it
+            # through untouched, and ``ex`` below must restore exactly
+            # the worker-stacked layout it arrived in
+            t, r, new_t = sq(shard), sq(res), sq(trk)
             if mode == "dense":
                 out, new_r = aggregate(
                     t, how=how, topology=topology,
@@ -561,18 +757,26 @@ def make_host_sync(mesh, *, mode: str = "sharded", how: str = "equal",
                     local_weight=local_weight, wire_dtype=wire_dtype,
                     residual=r, bucket_bytes=bucket_bytes)
             else:
-                out, new_r = sharded_sync(
+                out, new_r, new_t = sharded_opt_sync(
                     t, how=how, local_weight=local_weight,
                     wire_dtype=wire_dtype, residual=r,
-                    bucket_bytes=bucket_bytes)
-            return ex(out), ex(new_r)
-        return shard_map(inner, mesh=mesh, in_specs=(spec, spec),
-                         out_specs=(spec, spec))(tree, residual)
+                    bucket_bytes=bucket_bytes,
+                    opt_placement=opt_placement, tracker=new_t)
+            return ex(out), ex(new_r), ex(new_t)
+        return shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=(spec, spec, spec))(
+                             tree, residual, tracker)
 
     jitted = jax.jit(_sync)
 
+    if track_opt:
+        def run_tracked(tree, residual=None, tracker=None):
+            return jitted(tree, residual, tracker)
+        return run_tracked
+
     def run(tree, residual=None):
-        return jitted(tree, residual)
+        out, new_r, _ = jitted(tree, residual, None)
+        return out, new_r
 
     return run
 

@@ -174,6 +174,25 @@ class Config:
     sync_compression: str = "none"   # none | ef
     # Sharded-sync bucket size (MiB of fp32 parameters per collective).
     sync_bucket_mb: float = 4.0
+    # --- shard-resident optimizer placement (ISSUE 9) ----------------------
+    # opt_placement: where the round-boundary optimizer transform (the
+    # FedAvg blend + EF bookkeeping, and in gradients mode the round-level
+    # Adam moment tracker of the aggregated gradient) runs and where its
+    # state lives — the ZeRO-1 cross-replica weight-update scheme
+    # ("Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+    # Training", PAPERS.md).  "sharded" runs the apply between psum_scatter
+    # and all_gather on each worker's 1/N bucket shard and stores the
+    # round-optimizer moments sharded over the worker axis (per-worker
+    # state and apply FLOPs drop N-fold; only post-update weights ride the
+    # all_gather home); "replicated" is the post-gather full-size twin
+    # (every worker applies the whole update — the A/B gate + bench
+    # baseline); "auto" = sharded whenever the bucketed sharded sync
+    # engine is active.  Ring/double-ring gossip resolves to "local":
+    # gossip blends are worker-specific by construction (no global
+    # reduce), so there is no cross-replica-redundant apply to shard —
+    # see docs/ARCHITECTURE.md.  In fp32 the two placements are
+    # bit-identical (tests/test_opt_placement.py).
+    opt_placement: str = "auto"      # auto | replicated | sharded
     # --- runtime sanitizer (ISSUE 6) ---------------------------------------
     # sanitize: arm the round-loop correctness harness — the driver wraps
     # every round dispatch/wait in jax.transfer_guard("disallow") (any
@@ -246,6 +265,8 @@ class Config:
         _choices("sync_dtype", self.sync_dtype,
                  ("float32", "bfloat16", "int8"))
         _choices("sync_compression", self.sync_compression, ("none", "ef"))
+        _choices("opt_placement", self.opt_placement,
+                 ("auto", "replicated", "sharded"))
         if self.grad_accum < 1:
             raise ValueError(
                 f"grad_accum must be >= 1, got {self.grad_accum}")
@@ -259,6 +280,19 @@ class Config:
                 f"--sync_dtype {self.sync_dtype} is the bucketed engines' "
                 "compressed wire format; it cannot combine with "
                 "--sync_mode dense")
+        if self.opt_placement == "sharded" and self.sync_mode == "dense":
+            raise ValueError(
+                "--opt_placement sharded runs the optimizer apply between "
+                "psum_scatter and all_gather — a bucketed-sync-engine "
+                "stage; it cannot combine with --sync_mode dense")
+        if self.opt_placement == "replicated" and compressed_wire:
+            raise ValueError(
+                f"--opt_placement replicated cannot combine with "
+                f"--sync_dtype {self.sync_dtype}: a compressed wire "
+                "quantizes the gathered mean, which forces the "
+                "scale-then-encode apply onto the 1/N shard (the sharded "
+                "placement) — a post-gather replicated apply would gather "
+                "the uncompressed fp32 sum instead")
         if self.sync_compression == "ef" and not compressed_wire:
             raise ValueError(
                 "--sync_compression ef compensates compressed-wire "
@@ -346,7 +380,36 @@ class Config:
             return "dense"
         if self.sync_dtype in ("bfloat16", "int8"):
             return fast
+        if self.opt_placement == "sharded":
+            # the shard-resident apply is a stage of the bucketed engine;
+            # requesting it selects the fast path like a compressed wire
+            # does (explicit --sync_mode dense was rejected up front)
+            return fast
         return fast if backend == "tpu" else "dense"
+
+    def resolve_opt_placement(self, backend: str) -> str:
+        """Resolve ``--opt_placement`` into the placement actually run:
+        ``replicated`` | ``sharded`` | ``local``.
+
+        Gossip topologies (ring / double_ring) resolve to ``local``
+        regardless of the flag: every gossip blend output is
+        worker-specific by construction (each worker mixes its OWN value
+        with its predecessors' — there is no global reduce whose result
+        could be computed once and shared), so the blend arithmetic and
+        the EF residual are already worker-resident and nothing
+        cross-replica-redundant exists to shard (docs/ARCHITECTURE.md
+        documents what stays replicated and why).  For allreduce,
+        ``auto`` picks ``sharded`` exactly when the bucketed sharded
+        sync engine is active (compressed wire always is), mirroring the
+        sync-mode resolution; the dense per-leaf path has no
+        scatter/gather phases to place an apply between and reports
+        ``replicated`` (which its arithmetic literally is)."""
+        mode = self.resolve_sync_mode(backend)
+        if mode == "gossip" or self.topology != "allreduce":
+            return "local"
+        if self.opt_placement in ("replicated", "sharded"):
+            return self.opt_placement
+        return "sharded" if mode == "sharded" else "replicated"
 
     def parse_prompt_buckets(self) -> tuple[int, ...]:
         """``--serve_prompt_buckets`` as ascending unique lengths."""
@@ -542,6 +605,16 @@ def build_argparser() -> argparse.ArgumentParser:
                         "aggregation)")
     p.add_argument("--sync_bucket_mb", type=float, default=d.sync_bucket_mb,
                    help="sharded-sync bucket size in MiB per collective")
+    p.add_argument("--opt_placement", type=str, default=d.opt_placement,
+                   choices=["auto", "replicated", "sharded"],
+                   help="round-boundary optimizer placement (ZeRO-1 "
+                        "cross-replica weight update): sharded runs the "
+                        "apply between psum_scatter and all_gather on the "
+                        "1/N shard and stores round-optimizer moments "
+                        "sharded over the worker axis; replicated is the "
+                        "post-gather full-size twin; auto = sharded when "
+                        "the bucketed sync engine is active (gossip "
+                        "topologies are worker-local either way)")
     p.add_argument("--serve_max_batch", type=int, default=d.serve_max_batch,
                    help="serve: concurrent decode slots (the one fixed "
                         "shape the decode-step program compiles at)")

@@ -131,14 +131,19 @@ def checkpoint_metadata(cfg: Config, num_classes: int,
     inspection tools) rebuild the trained model straight from a checkpoint
     directory instead of the user restating ``--model``/layer flags
     (ISSUE 7 satellite).  Keys consumed by
-    ``serve.engine.model_from_metadata``."""
+    ``serve.engine.model_from_metadata``.  ``opt_placement`` (ISSUE 9)
+    records the RESOLVED round-optimizer placement the state was saved
+    with — restore re-lays the sharded/replicated moment rows out for the
+    restoring run's placement (``checkpoint.restore_checkpoint``)."""
     return {"model": cfg.model, "num_classes": int(num_classes),
             "scan_layers": bool(scan_layers),
             "compute_dtype": cfg.compute_dtype,
             "num_kv_heads": int(cfg.num_kv_heads),
             "num_experts": int(cfg.num_experts),
             "capacity_factor": float(cfg.expert_capacity_factor),
-            "dataset": cfg.dataset}
+            "dataset": cfg.dataset,
+            "opt_placement": cfg.resolve_opt_placement(
+                jax.default_backend())}
 
 
 @contextmanager
@@ -755,7 +760,17 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         "worker_specific_train_accuracies": [],
         "worker_specific_val_losses": [],
         "worker_specific_val_accuracies": [],
-        "sync_engine": engine.sync_mode,
+        # the sync/optimizer engine provenance of this run artifact
+        # (ISSUE 9 satellite): which sync program ran, where the
+        # round-boundary optimizer apply was placed, and each state
+        # component's measured per-worker resident bytes — so the
+        # sharded placement's N-fold round_opt drop is a recorded
+        # number, not a claim ("mode" keeps the pre-ISSUE-9 string)
+        "sync_engine": {
+            "mode": engine.sync_mode,
+            "opt_placement": engine.opt_placement,
+            "per_worker_state_bytes": engine.state_resident_bytes(state),
+        },
     }
 
     def _capped(parts, caps):
@@ -1091,6 +1106,10 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         for wid in worker_ids:   # joiners get fresh per-logical-id lists
             while len(results["all_workers_losses"]) <= wid:
                 results["all_workers_losses"].append([])
+        # the worker count changed, so every per-worker resident-bytes
+        # figure (and the sharded round_opt rows) changed with it
+        results["sync_engine"]["per_worker_state_bytes"] = \
+            engine.state_resident_bytes(state)
 
     def membership_boundary(rnd: int) -> None:
         """Resolve + apply membership events at the boundary entering
@@ -1169,7 +1188,10 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             data_mode=cfg.data_mode, fixed_ratio=cfg.fixed_ratio,
             rng=rng, trainset_labels=trainset.labels,
             valset_labels=valset.labels, next_worker_id=plan.next_id,
-            n_round0=n_round0)
+            n_round0=n_round0,
+            round_opt_placement=(engine.opt_placement
+                                 if engine.round_opt_on else None),
+            sync_bucket_bytes=engine.sync_bucket_bytes)
         el["snapshots"].append(elastic_lib.snapshot_copy(snap))
         install_from_snapshot(snap)
         el["events"].extend(change.applied)

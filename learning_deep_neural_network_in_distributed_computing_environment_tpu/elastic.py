@@ -216,7 +216,9 @@ def host_state_snapshot(state):
 
 
 def reshard_state(host_state, kept_positions: list[int],
-                  joiner_ids: list[int], *, seed: int):
+                  joiner_ids: list[int], *, seed: int,
+                  round_opt_placement: str | None = None,
+                  sync_bucket_bytes: int | None = None):
     """Row-edit a host-numpy worker-stacked ``TrainState`` for a
     membership change.
 
@@ -229,17 +231,45 @@ def reshard_state(host_state, kept_positions: list[int],
     ``fold_in(key(seed), logical_id)`` stream (ids are never recycled,
     so the stream is unique for the life of the run), and its
     error-feedback ``sync_residual`` rows are ZERO — a cloned residual
-    would re-inject the donor's accumulated quantization error twice."""
+    would re-inject the donor's accumulated quantization error twice.
+
+    The round-optimizer tracker (``TrainState.round_opt``, ISSUE 9) is
+    NOT per-worker state and must not be row-edited: its rows are
+    worker-axis SHARDS of one worker-invariant moment vector (or N
+    identical replicas), keyed to the sync engine's bucket plan — which
+    re-tiles when the worker count changes.  It is re-laid-out instead
+    (``comms.round_opt_relayout``): reconstruct the vector, re-pad for
+    the new count, re-split.  ``round_opt_placement``/
+    ``sync_bucket_bytes`` describe the engine layout; required whenever
+    ``host_state.round_opt`` is present."""
     if not kept_positions:
         raise ValueError("membership change left no surviving workers")
+    round_opt = host_state.round_opt
+    if round_opt is not None:
+        if round_opt_placement is None or sync_bucket_bytes is None:
+            raise ValueError(
+                "host_state carries a round-optimizer tracker: "
+                "reshard_state needs round_opt_placement and "
+                "sync_bucket_bytes to re-lay it out")
+        from . import comms
+        n_new = len(kept_positions) + len(joiner_ids)
+        per_worker = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(np.shape(x)[1:],
+                                           np.asarray(x).dtype),
+            host_state.params)
+        round_opt = comms.round_opt_relayout(
+            round_opt, per_worker, n_new, placement=round_opt_placement,
+            bucket_bytes=int(sync_bucket_bytes))
+        host_state = host_state.replace(round_opt=None)
     take = lambda x: np.take(np.asarray(x), kept_positions, axis=0)
     base = jax.tree_util.tree_map(take, host_state)
     k = len(joiner_ids)
     if not k:
-        return base
+        return base.replace(round_opt=round_opt)
     clone = lambda x: np.concatenate(
         [x, np.repeat(x[:1], k, axis=0)], axis=0)
     out = jax.tree_util.tree_map(clone, base)
+    out = out.replace(round_opt=round_opt)
     nk = len(kept_positions)
     rng_rows = np.stack([
         np.asarray(jax.random.key_data(
@@ -265,7 +295,10 @@ def build_snapshot(*, epoch: int, change: MembershipChange, old_state,
                    trainset_labels=None, valset_labels=None,
                    joiner_spb_mode: str = "mean",
                    next_worker_id: int = 0,
-                   n_round0: int = 0) -> MembershipSnapshot:
+                   n_round0: int = 0,
+                   round_opt_placement: str | None = None,
+                   sync_bucket_bytes: int | None = None
+                   ) -> MembershipSnapshot:
     """Assemble the full post-event configuration for round ``epoch``.
 
     Runs entirely on host state: the survivor-EMA edit (departed rows
@@ -297,7 +330,9 @@ def build_snapshot(*, epoch: int, change: MembershipChange, old_state,
         fixed_classes=fixed_classes, fixed_ratio=fixed_ratio, rng=rng)
     host_state = reshard_state(
         host_state_snapshot(old_state), change.kept_positions,
-        change.joiner_ids, seed=seed)
+        change.joiner_ids, seed=seed,
+        round_opt_placement=round_opt_placement,
+        sync_bucket_bytes=sync_bucket_bytes)
     _maybe_crash("mid_reshard")
     return MembershipSnapshot(
         epoch=int(epoch), worker_ids=list(change.worker_ids),

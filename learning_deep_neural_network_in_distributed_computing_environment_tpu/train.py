@@ -79,6 +79,17 @@ class TrainState(struct.PyTreeNode):
     # the Adam moments: each round re-injects what bf16 wire rounding
     # dropped from this worker's previous contribution (comms.sharded_sync).
     sync_residual: PyTree = None
+    # Round-optimizer Adam moments of the aggregated gradient (ISSUE 9;
+    # gradients-aggregation mode under the sharded sync engine; None
+    # otherwise).  The tracked quantity — the cross-worker MEAN gradient —
+    # is worker-invariant, which is what makes this the one piece of
+    # optimizer state the ZeRO-1 placement can shard: under
+    # ``--opt_placement sharded`` each worker's row holds only the 1/N
+    # bucket shard it owns ([N, padded/N] leaves — per-worker state and
+    # update FLOPs at 1/N); under ``replicated`` every row is the full
+    # vector ([N, padded] — the N-identical-copies baseline).  Layouts
+    # interconvert exactly (comms.round_opt_relayout, checkpoint restore).
+    round_opt: PyTree = None
 
 
 def _first_worker_row(x):
@@ -411,6 +422,34 @@ class LocalSGDEngine:
                         and cfg.aggregation_by == "weights"
                         and self.sync_mode in ("sharded", "gossip"))
         self.sync_bucket_bytes = max(1, int(cfg.sync_bucket_mb * (1 << 20)))
+        # --- shard-resident optimizer placement (ISSUE 9) ---------------
+        # Where the round-boundary apply runs and where its state lives:
+        # "sharded" = between psum_scatter and all_gather on the 1/N
+        # shard; "replicated" = post-gather full-size (the A/B twin);
+        # "local" = gossip topologies (worker-local blends, nothing
+        # cross-replica-redundant to shard).  fp32 placements are
+        # bitwise-identical (tests/test_opt_placement.py).
+        self.opt_placement = cfg.resolve_opt_placement(
+            jax.default_backend())
+        # The round-optimizer Adam moment tracker (TrainState.round_opt)
+        # follows the aggregated MEAN gradient — gradients-aggregation
+        # mode only (in weights mode the aggregate replaces the params
+        # and no boundary moments exist), and only under the bucketed
+        # sharded engine (the tracker state is laid out by its bucket
+        # plan).  Inner mesh axes (TP/PP/EP/FSDP/SP) shard the gradient
+        # leaves themselves, which would make the bucket plan
+        # per-device; the tracker stays off there (documented).
+        self.round_opt_on = (
+            cfg.aggregation_by == "gradients"
+            and self.sync_mode == "sharded"
+            and self.opt_placement in ("replicated", "sharded")
+            and not self._inner_axes)
+        if (cfg.opt_placement == "sharded"
+                and self.opt_placement == "local"):
+            log.info(
+                "opt_placement sharded requested on a %s topology: gossip "
+                "blends are worker-local (no global reduce), resolved to "
+                "'local' — see docs/ARCHITECTURE.md", cfg.topology)
         # Packed-path sync placement: on XLA:CPU the sync stays FUSED in
         # the round program — dispatching a second collective program
         # while the round is in flight risks the 1-core rendezvous
@@ -440,13 +479,16 @@ class LocalSGDEngine:
         """
         return self.cfg.resolve_sync_mode(jax.default_backend())
 
-    def _sync_body(self, params, grads, residual):
+    def _sync_body(self, params, grads, residual, round_opt=None):
         """The once-per-round sync point, per worker (inside shard_map).
 
-        Returns ``(params', residual', agg_grad_norm)``.  Weights mode
-        replaces params with the aggregate (FedAvg); gradients mode runs
-        the collectives on the stale last-batch grads and reports only
-        their norm (reference semantics, SURVEY.md 3.2)."""
+        Returns ``(params', residual', round_opt', agg_grad_norm)``.
+        Weights mode replaces params with the aggregate (FedAvg);
+        gradients mode runs the collectives on the stale last-batch
+        grads and reports only their norm (reference semantics,
+        SURVEY.md 3.2) — plus, when the round-optimizer tracker is armed
+        (ISSUE 9), the shard-resident Adam moment update of the
+        aggregated mean gradient."""
         cfg = self.cfg
         agg_grad_norm = jnp.zeros(())
         fast = self.sync_mode in ("sharded", "gossip")
@@ -459,27 +501,44 @@ class LocalSGDEngine:
                     params, how=cfg.aggregation_type,
                     topology=cfg.topology, local_weight=cfg.local_weight)
         else:
-            if fast:
+            if self.round_opt_on:
+                agg, _, round_opt = comms.sharded_opt_sync(
+                    grads, tracker=round_opt, **self._fast_kwargs())
+            elif fast:
                 agg, _ = self._fast_sync(grads, None)
             else:
                 agg = comms.aggregate(
                     grads, how=cfg.aggregation_type,
                     topology=cfg.topology, local_weight=cfg.local_weight)
             agg_grad_norm = self._grad_global_norm(agg)
-        return params, residual, agg_grad_norm
+        return params, residual, round_opt, agg_grad_norm
+
+    def _fast_kwargs(self, residual=None) -> dict:
+        """Shared kwargs of the bucketed sharded engine calls, including
+        the resolved optimizer placement (the dense twin and gossip never
+        see a placement — their arithmetic is per-leaf replicated /
+        worker-local by construction)."""
+        cfg = self.cfg
+        placement = ("replicated" if self.opt_placement == "replicated"
+                     else "sharded")
+        return dict(how=cfg.aggregation_type,
+                    local_weight=cfg.local_weight,
+                    wire_dtype=self.sync_wire_dtype, residual=residual,
+                    bucket_bytes=self.sync_bucket_bytes,
+                    opt_placement=placement)
 
     def _fast_sync(self, tree, residual):
         """Run the resolved bucketed fast engine on one pytree:
         the reduce-scatter program for ``sharded``, the ppermute gossip
         program for ``gossip`` — same kwargs, same
         ``(out, new_residual)`` contract."""
-        cfg = self.cfg
-        kw = dict(how=cfg.aggregation_type, local_weight=cfg.local_weight,
-                  wire_dtype=self.sync_wire_dtype, residual=residual,
-                  bucket_bytes=self.sync_bucket_bytes)
         if self.sync_mode == "gossip":
-            return comms.gossip_sync(tree, topology=cfg.topology, **kw)
-        return comms.sharded_sync(tree, **kw)
+            kw = self._fast_kwargs(residual)
+            # gossip has no apply stage to place (worker-local blends)
+            kw.pop("opt_placement")
+            return comms.gossip_sync(tree, topology=self.cfg.topology,
+                                     **kw)
+        return comms.sharded_sync(tree, **self._fast_kwargs(residual))
 
     def _arm_sync_stats(self, params_stacked) -> None:
         """Reset ``last_sync_stats`` for the round being dispatched: the
@@ -505,6 +564,28 @@ class LocalSGDEngine:
                                 "sync_mode": self.sync_mode,
                                 "sync_ms": 0.0}
         self._sync_probe = None
+
+    def state_resident_bytes(self, state: TrainState) -> dict:
+        """Per-worker RESIDENT bytes of each ``TrainState`` component
+        (ISSUE 9 satellite: the N-fold optimizer-state drop as a measured
+        number, not a claim).  Every leaf carries a leading worker axis
+        sharded over ``data``, so a worker's share of a leaf is
+        ``nbytes / N`` — for the sharded round-optimizer layout that is
+        1/N of the tracked vector, for the replicated layout the whole
+        vector (N identical copies across the axis)."""
+        def per_worker(tree) -> int:
+            total = 0
+            for leaf in jax.tree_util.tree_leaves(tree):
+                size = int(np.prod(np.shape(leaf), dtype=np.int64))
+                itemsize = np.dtype(leaf.dtype).itemsize
+                rows = max(1, int(np.shape(leaf)[0])) if np.ndim(leaf) \
+                    else 1
+                total += size * itemsize // rows
+            return total
+        return {"params": per_worker(state.params),
+                "opt_state": per_worker(state.opt_state),
+                "ef_residual": per_worker(state.sync_residual),
+                "round_opt": per_worker(state.round_opt)}
 
     # ------------------------------------------------------------------
     # Multi-host data movement
@@ -592,6 +673,10 @@ class LocalSGDEngine:
             sync_residual=(jax.tree_util.tree_map(
                 lambda x: jnp.zeros((n, *x.shape), jnp.float32), params)
                 if self.sync_ef else None),
+            round_opt=(comms.round_opt_init(
+                params, n, placement=self.opt_placement,
+                bucket_bytes=self.sync_bucket_bytes)
+                if self.round_opt_on else None),
         )
         return self.stage_state(state)
 
@@ -652,7 +737,8 @@ class LocalSGDEngine:
             params=pfull, batch_stats=dspec(state.batch_stats),
             opt_state=opt_specs(state.opt_state),
             lr_epoch=self._spec, rng=self._spec,
-            sync_residual=pfull if self.sync_ef else None)
+            sync_residual=pfull if self.sync_ef else None,
+            round_opt=dspec(state.round_opt))
 
     # ------------------------------------------------------------------
     # The round program
@@ -1131,9 +1217,11 @@ class LocalSGDEngine:
             # it (measured collective wall, two-rounds-in-flight chain).
             agg_grad_norm = jnp.zeros(())
             residual = state.sync_residual
+            round_opt = state.round_opt
             if not self.split_sync:
-                params, residual, agg_grad_norm = self._sync_body(
-                    params, last_grads, residual)
+                params, residual, round_opt, agg_grad_norm = \
+                    self._sync_body(params, last_grads, residual,
+                                    round_opt)
 
             # cross-worker global-epoch metric means (trainer.py:152-162)
             metrics = dict(
@@ -1150,7 +1238,8 @@ class LocalSGDEngine:
             )
             new_state = TrainState(params=params, batch_stats=batch_stats,
                                    opt_state=opt_state, lr_epoch=lr_epoch,
-                                   rng=rng, sync_residual=residual)
+                                   rng=rng, sync_residual=residual,
+                                   round_opt=round_opt)
             if emit_grads:
                 # split_sync x gradients mode: the standalone sync program
                 # aggregates the stale last-batch grads, so the round
@@ -1264,7 +1353,12 @@ class LocalSGDEngine:
                 new_state = new_state.replace(params=params,
                                               sync_residual=residual)
             else:
-                sync_norm = sync(outs[1])
+                if self.round_opt_on:
+                    sync_norm, new_tracker = sync(outs[1],
+                                                  new_state.round_opt)
+                    new_state = new_state.replace(round_opt=new_tracker)
+                else:
+                    sync_norm = sync(outs[1])
                 fence = sync_norm
             self._sync_probe = (metrics["train_loss"], fence)
         return new_state, ("packed", metrics, sync_norm, fence)
@@ -1431,21 +1525,34 @@ class LocalSGDEngine:
         if cfg.aggregation_by == "weights":
             if self.sync_ef:
                 def per_worker(params, residual):
-                    p, r, _ = self._sync_body(params, None, residual)
+                    p, r, _t, _ = self._sync_body(params, None, residual)
                     return p, r, _fence(p)
                 return self._wrap_stacked(
                     per_worker, [pspec, pspec],
                     out_specs=(pspec, pspec, self._spec), donate=(0, 1))
 
             def per_worker(params):
-                p, _, _ = self._sync_body(params, None, None)
+                p, _r, _t, _ = self._sync_body(params, None, None)
                 return p, _fence(p)
             return self._wrap_stacked(per_worker, [pspec],
                                       out_specs=(pspec, self._spec),
                                       donate=(0,))
 
+        if self.round_opt_on:
+            # gradients mode with the round-optimizer tracker (ISSUE 9):
+            # the standalone program consumes and donates the tracker
+            # rows alongside the grads — shard-resident moments update in
+            # place between the scatter and the norm's gather
+            def per_worker(grads, round_opt):
+                _p, _r, trk, norm = self._sync_body(None, grads, None,
+                                                    round_opt)
+                return norm, trk
+            return self._wrap_stacked(per_worker, [pspec, self._spec],
+                                      out_specs=(self._spec, self._spec),
+                                      donate=(0, 1))
+
         def per_worker(grads):
-            _, _, norm = self._sync_body(None, grads, None)
+            _p, _r, _t, norm = self._sync_body(None, grads, None)
             return norm
         return self._wrap_stacked(per_worker, [pspec],
                                   out_specs=self._spec, donate=(0,))
@@ -1557,6 +1664,7 @@ class LocalSGDEngine:
         sync = self._round_cache["sync"]
         self._arm_sync_stats(params)
         residual = state.sync_residual
+        round_opt = state.round_opt
         if cfg.aggregation_by == "weights":
             if self.sync_ef:
                 params, residual, fence = sync(params, residual)
@@ -1568,7 +1676,10 @@ class LocalSGDEngine:
             agg_grad_norm = self._put(
                 np.zeros((self.n_workers,), np.float32), self._spec)
         else:
-            agg_grad_norm = sync(last_grads)
+            if self.round_opt_on:
+                agg_grad_norm, round_opt = sync(last_grads, round_opt)
+            else:
+                agg_grad_norm = sync(last_grads)
             fence = agg_grad_norm
         # everything before the sync is already materialized (the
         # per-epoch barrier above), so the block on the fence times the
@@ -1586,7 +1697,7 @@ class LocalSGDEngine:
         new_state = TrainState(
             params=params, batch_stats=batch_stats, opt_state=opt_state,
             lr_epoch=self._round_cache["bump_epoch"](state.lr_epoch),
-            rng=rng, sync_residual=residual)
+            rng=rng, sync_residual=residual, round_opt=round_opt)
         return new_state, ("streamed", per_epoch, agg_grad_norm)
 
     def _assemble_streamed(self, per_epoch, agg_grad_norm) -> dict:

@@ -320,6 +320,33 @@ prog = jax.jit(fn, donate_argnums=(0,))
 """
         assert rules_for(src) == []
 
+    def test_apply_stage_tracker_use_after_donate_flagged(self):
+        # ISSUE 9 fixture: the shard-resident apply-stage program donates
+        # BOTH the grads and the round-optimizer tracker rows
+        # (train._build_sync donate=(0, 1)); reading the donated tracker
+        # input after the call is the exact hazard class R4 exists for
+        src = """
+import jax
+def sync_round(sync, grads, round_opt):
+    prog = jax.jit(sync, donate_argnums=(0, 1))
+    norm, new_opt = prog(grads, round_opt)
+    stale = round_opt  # donated tracker rows read after the call
+    return norm, stale
+"""
+        assert "R4" in rules_for(src)
+
+    def test_apply_stage_tracker_rebound_clean(self):
+        # the engine's real shape: the donated tracker name is rebound to
+        # the program's output before any further read
+        src = """
+import jax
+def sync_round(sync, grads, round_opt):
+    prog = jax.jit(sync, donate_argnums=(0, 1))
+    norm, round_opt = prog(grads, round_opt)
+    return norm, round_opt
+"""
+        assert "R4" not in rules_for(src)
+
     def test_rebound_name_no_longer_shard_map_clean(self):
         src = """
 import jax

@@ -1,0 +1,494 @@
+"""Shard-resident optimizer placement (ISSUE 9 tentpole).
+
+The round-boundary sync is scatter -> APPLY -> gather; ``--opt_placement``
+places the apply stage and its state (the ZeRO-1 cross-replica
+weight-update scheme, arXiv 2004.13336):
+
+- fp32 apply is BITWISE placement-invariant (sharded == replicated ==
+  dense) across worker counts and both blend hows;
+- the gradients-mode round-optimizer Adam moments (TrainState.round_opt)
+  track the worker-invariant mean gradient, so the sharded layout stores
+  each worker's 1/N bucket shard — exactly 1/N per-worker bytes — and is
+  the exact row-partition of the replicated layout;
+- checkpoints re-layout across placements on restore, elastic membership
+  changes re-tile the tracker for the new worker count;
+- gossip topologies resolve to the "local" placement (worker-local
+  blends — nothing cross-replica-redundant to shard).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from learning_deep_neural_network_in_distributed_computing_environment_tpu import (
+    comms,
+    elastic as elastic_lib,
+    mesh as mesh_lib,
+)
+from learning_deep_neural_network_in_distributed_computing_environment_tpu import checkpoint as ckpt_lib
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import get_model
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.train import (
+    LocalSGDEngine,
+    TrainState,
+)
+
+N = 8
+SHAPES = {"a": (13, 7), "b": (257,), "c": (31, 5), "d": (3,)}
+TINY_BUCKET = 1024
+
+
+def stacked_tree(n=N, seed=0):
+    rng = np.random.default_rng(seed)
+    return {k: jnp.asarray(rng.normal(size=(n, *s)), jnp.float32)
+            for k, s in SHAPES.items()}
+
+
+def per_worker_shapes():
+    return {k: jax.ShapeDtypeStruct(s, jnp.float32)
+            for k, s in SHAPES.items()}
+
+
+def sub_mesh(k):
+    return mesh_lib.build_mesh({"data": k}, devices=jax.devices()[:k])
+
+
+def small_cfg(**kw):
+    base = dict(model="mlp", dataset="mnist", epochs_local=2,
+                epochs_global=2, batch_size=8, compute_dtype="float32",
+                augment=False, aggregation_by="weights")
+    base.update(kw)
+    return Config(**base)
+
+
+def make_engine(mesh, cfg):
+    return LocalSGDEngine(get_model("mlp", num_classes=10, hidden=16),
+                          mesh, cfg)
+
+
+def make_packs(n=8, steps=4, b=8, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, steps, b, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, (n, steps, b)).astype(np.int32)
+    m = np.ones((n, steps, b), np.float32)
+    return x, y, m
+
+
+class TestPlacementResolution:
+    def test_auto_follows_the_sync_engine(self):
+        # CPU fp32 auto-resolves the dense sync engine, whose arithmetic
+        # is literally replicated; the bucketed engine pulls the apply
+        # onto the shard
+        assert small_cfg().resolve_opt_placement("cpu") == "replicated"
+        assert small_cfg(
+            sync_mode="sharded").resolve_opt_placement("cpu") == "sharded"
+        assert small_cfg().resolve_opt_placement("tpu") == "sharded"
+        assert small_cfg(
+            sync_dtype="bfloat16", sync_compression="ef",
+        ).resolve_opt_placement("cpu") == "sharded"
+
+    def test_explicit_sharded_selects_the_fast_engine(self):
+        cfg = small_cfg(opt_placement="sharded")
+        assert cfg.resolve_sync_mode("cpu") == "sharded"
+        assert cfg.resolve_opt_placement("cpu") == "sharded"
+
+    @pytest.mark.parametrize("topology", ["ring", "double_ring"])
+    def test_gossip_resolves_local(self, topology):
+        # gossip blends are worker-specific by construction: nothing
+        # cross-replica-redundant exists to shard (docs/ARCHITECTURE.md)
+        for flag in ("auto", "replicated", "sharded"):
+            cfg = small_cfg(topology=topology, opt_placement=flag)
+            assert cfg.resolve_opt_placement("cpu") == "local"
+
+    def test_sharded_with_dense_sync_rejected(self):
+        with pytest.raises(ValueError, match="sync_mode dense"):
+            small_cfg(opt_placement="sharded", sync_mode="dense")
+
+    def test_replicated_with_compressed_wire_rejected(self):
+        # the gathered payload IS the encoded mean: the scale must run
+        # before the encode, on the shard
+        with pytest.raises(ValueError, match="replicated"):
+            small_cfg(opt_placement="replicated", sync_dtype="bfloat16")
+
+    def test_comms_rejects_compressed_replicated_apply(self, mesh8):
+        tree = stacked_tree()
+        with pytest.raises(Exception, match="sharded"):
+            comms.make_host_sync(
+                mesh8, mode="sharded", wire_dtype=jnp.bfloat16,
+                opt_placement="replicated")(tree)
+
+
+class TestApplyPlacementBitwise:
+    @pytest.mark.parametrize("k", [2, 4, 8])
+    @pytest.mark.parametrize("how", ["equal", "weighted"])
+    def test_fp32_sharded_apply_bitwise_equals_replicated(self, k, how):
+        """The acceptance gate: scatter->apply->gather with the apply on
+        the 1/N shard vs the post-gather replicated twin — bitwise, and
+        both bitwise == the dense all-reduce."""
+        mesh = sub_mesh(k)
+        tree = stacked_tree(n=k)
+        dense = comms.make_host_sync(mesh, mode="dense", how=how,
+                                     local_weight=0.3)(tree)[0]
+        outs = {
+            pl: comms.make_host_sync(
+                mesh, mode="sharded", how=how, local_weight=0.3,
+                bucket_bytes=TINY_BUCKET, opt_placement=pl)(tree)[0]
+            for pl in ("replicated", "sharded")}
+        for key in SHAPES:
+            a = np.asarray(outs["replicated"][key])
+            b = np.asarray(outs["sharded"][key])
+            assert np.array_equal(a, b), (how, key)
+            assert np.array_equal(np.asarray(dense[key]), b), (how, key)
+
+
+class TestRoundOptTracker:
+    def test_init_layout_bytes_exactly_one_nth(self):
+        pw = per_worker_shapes()
+        byt = {}
+        for pl in ("replicated", "sharded"):
+            trk = comms.round_opt_init(pw, N, placement=pl,
+                                       bucket_bytes=TINY_BUCKET)
+            assert len(trk) == len(comms.bucket_plan(
+                list(pw.values()), N, TINY_BUCKET))
+            byt[pl] = sum(l.nbytes // N
+                          for l in jax.tree_util.tree_leaves(trk))
+        assert byt["replicated"] == N * byt["sharded"]
+
+    @pytest.mark.parametrize("how", ["equal", "weighted"])
+    def test_sharded_rows_partition_the_replicated_vector(self, mesh8,
+                                                          how):
+        tree = stacked_tree()
+        trackers = {}
+        for pl in ("replicated", "sharded"):
+            trk = comms.round_opt_init(per_worker_shapes(), N,
+                                       placement=pl,
+                                       bucket_bytes=TINY_BUCKET)
+            fn = comms.make_host_sync(
+                mesh8, mode="sharded", how=how, local_weight=0.3,
+                bucket_bytes=TINY_BUCKET, opt_placement=pl,
+                track_opt=True)
+            for _ in range(2):   # two rounds: moments actually decay
+                _out, _r, trk = jax.block_until_ready(
+                    fn(tree, None, trk))
+            trackers[pl] = jax.device_get(trk)
+        some_nonzero = False
+        for b in trackers["sharded"]:
+            for m in ("mu", "nu"):
+                srows = np.asarray(trackers["sharded"][b][m])
+                rrows = np.asarray(trackers["replicated"][b][m])
+                # replicated layout: N identical copies of the vector
+                assert np.array_equal(
+                    rrows, np.broadcast_to(rrows[:1], rrows.shape))
+                # sharded layout: its exact row-partition, bitwise
+                assert np.array_equal(srows.reshape(-1), rrows[0]), (b, m)
+                some_nonzero |= bool(np.abs(srows).max() > 0)
+        assert some_nonzero
+
+    def test_tracker_follows_adam_moments_of_the_mean(self, mesh8):
+        # one bucket, one round: mu = (1-b1) * mean, nu = (1-b2) * mean^2
+        tree = stacked_tree()
+        trk = comms.round_opt_init(per_worker_shapes(), N,
+                                   placement="replicated")
+        _out, _r, trk = comms.make_host_sync(
+            mesh8, mode="sharded", opt_placement="replicated",
+            track_opt=True)(tree, None, trk)
+        flat = np.concatenate([
+            np.asarray(tree[k], np.float32).sum(0).reshape(-1) / N
+            for k in sorted(SHAPES)])
+        got = np.asarray(jax.device_get(trk)[comms._bucket_name(0)]["mu"])
+        filled = flat.size
+        np.testing.assert_allclose(
+            got[0][:filled], (1.0 - comms.ROUND_ADAM_B1) * flat,
+            rtol=1e-6, atol=1e-8)
+        assert np.all(got[0][filled:] == 0)   # padding moments stay zero
+        nu = np.asarray(jax.device_get(trk)[comms._bucket_name(0)]["nu"])
+        np.testing.assert_allclose(
+            nu[0][:filled], (1.0 - comms.ROUND_ADAM_B2) * flat * flat,
+            rtol=1e-5, atol=1e-10)
+
+    def test_relayout_roundtrips_and_validates(self):
+        pw = per_worker_shapes()
+        trk = jax.device_get(comms.round_opt_init(
+            pw, N, placement="sharded", bucket_bytes=TINY_BUCKET))
+        # fill only the FILLED region (padding carries exactly-zero
+        # moments by construction — the padded mean is zero every round)
+        rng = np.random.default_rng(0)
+        plan = comms.bucket_plan(list(pw.values()), N, TINY_BUCKET)
+        for i, b in enumerate(plan):
+            filled = sum(s for (_i, _o, s) in b.items)
+            for m in ("mu", "nu"):
+                vec = np.zeros(b.padded, np.float32)
+                vec[:filled] = rng.normal(size=filled)
+                trk[comms._bucket_name(i)][m] = vec.reshape(N, -1)
+        down = comms.round_opt_relayout(trk, pw, 3, placement="sharded",
+                                        bucket_bytes=TINY_BUCKET)
+        back = comms.round_opt_relayout(down, pw, N, placement="sharded",
+                                        bucket_bytes=TINY_BUCKET)
+        for b in trk:
+            for m in ("mu", "nu"):
+                assert np.array_equal(trk[b][m], back[b][m]), (b, m)
+        with pytest.raises(ValueError, match="bucket"):
+            comms.round_opt_relayout({}, pw, 4, placement="sharded",
+                                     bucket_bytes=TINY_BUCKET)
+
+
+class TestEngineOptPlacement:
+    def _round(self, mesh8, cfg):
+        engine = make_engine(mesh8, cfg)
+        x, y, m = make_packs()
+        state = engine.init_state(jax.random.key(0), x[0, 0])
+        state, mx = engine.round(state, (x, y, m), (x, y, m))
+        return engine, state, mx
+
+    def test_weights_round_bitwise_across_placements(self, mesh8):
+        states = {}
+        for pl in ("replicated", "sharded"):
+            eng, st, _ = self._round(
+                mesh8, small_cfg(sync_mode="sharded",
+                                 sync_bucket_mb=0.001, opt_placement=pl))
+            assert eng.opt_placement == pl
+            assert st.round_opt is None    # weights mode: no boundary
+            states[pl] = st                # moments exist to track
+        for a, b in zip(
+                jax.tree_util.tree_leaves(states["replicated"].params),
+                jax.tree_util.tree_leaves(states["sharded"].params)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_gradients_tracker_layouts_and_norm_bitwise(self, mesh8):
+        outs = {}
+        for pl in ("replicated", "sharded"):
+            eng, st, mx = self._round(
+                mesh8, small_cfg(aggregation_by="gradients",
+                                 sync_mode="sharded",
+                                 sync_bucket_mb=0.001, opt_placement=pl))
+            assert eng.round_opt_on
+            assert st.round_opt is not None
+            outs[pl] = (jax.device_get(st.round_opt),
+                        np.asarray(mx["agg_grad_norm"]))
+        # the reported aggregated-grad norm is placement-invariant
+        assert np.array_equal(outs["replicated"][1], outs["sharded"][1])
+        for b in outs["sharded"][0]:
+            for m in ("mu", "nu"):
+                srows = np.asarray(outs["sharded"][0][b][m])
+                rrows = np.asarray(outs["replicated"][0][b][m])
+                assert np.array_equal(srows.reshape(-1), rrows[0]), (b, m)
+        # the N-fold per-worker state drop, measured
+        per = lambda t: sum(l.nbytes // N
+                            for l in jax.tree_util.tree_leaves(t))
+        assert per(outs["replicated"][0]) == N * per(outs["sharded"][0])
+
+    def test_tracker_off_under_inner_axes_and_weights_mode(self, mesh8):
+        eng = make_engine(mesh8, small_cfg(sync_mode="sharded"))
+        assert not eng.round_opt_on    # weights mode
+        eng = make_engine(mesh8, small_cfg(aggregation_by="gradients"))
+        assert not eng.round_opt_on    # dense engine on CPU fp32 auto
+
+
+class TestCheckpointCrossPlacement:
+    def _state_with_tracker(self, mesh8, placement):
+        cfg = small_cfg(aggregation_by="gradients", sync_mode="sharded",
+                        sync_bucket_mb=0.001, opt_placement=placement)
+        engine = make_engine(mesh8, cfg)
+        state = engine.init_state(
+            jax.random.key(0), np.zeros((8, 28, 28, 1), np.float32))
+        # deterministic nonzero moments with the zero-pad invariant held
+        host = jax.device_get(state.round_opt)
+        rng = np.random.default_rng(7)
+        pw = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype),
+            jax.device_get(state.params))
+        plan = comms.bucket_plan(jax.tree_util.tree_leaves(pw), N,
+                                 engine.sync_bucket_bytes)
+        for i, b in enumerate(plan):
+            filled = sum(s for (_i, _o, s) in b.items)
+            vec = np.zeros(b.padded, np.float32)
+            vec[:filled] = rng.normal(size=filled)
+            for m in ("mu", "nu"):
+                name = comms._bucket_name(i)
+                host[name][m] = (vec.reshape(N, -1)
+                                 if placement == "sharded" else
+                                 np.broadcast_to(vec,
+                                                 (N, b.padded)).copy())
+        filled_state = state.replace(round_opt=jax.tree_util.tree_map(
+            lambda a, t: jax.device_put(np.asarray(a),
+                                        t.sharding),
+            host, state.round_opt))
+        return engine, filled_state
+
+    def test_roundtrip_across_placements_both_directions(self, mesh8,
+                                                         tmp_path):
+        _eng_s, st_s = self._state_with_tracker(mesh8, "sharded")
+        _eng_r, tmpl_r = self._state_with_tracker(mesh8, "replicated")
+        # sharded save -> replicated restore
+        ckpt_lib.save_checkpoint(str(tmp_path / "s"), st_s, 1)
+        got_r, ep = ckpt_lib.restore_checkpoint(
+            ckpt_lib.latest_checkpoint(str(tmp_path / "s")), tmpl_r)
+        assert ep == 1
+        for b in jax.device_get(st_s.round_opt):
+            for m in ("mu", "nu"):
+                s = np.asarray(jax.device_get(st_s.round_opt)[b][m])
+                r = np.asarray(jax.device_get(got_r.round_opt)[b][m])
+                assert np.array_equal(
+                    r, np.broadcast_to(r[:1], r.shape)), (b, m)
+                assert np.array_equal(s.reshape(-1), r[0]), (b, m)
+        # replicated save -> sharded restore, closing the loop bitwise
+        ckpt_lib.save_checkpoint(str(tmp_path / "r"), got_r, 2)
+        got_s, _ = ckpt_lib.restore_checkpoint(
+            ckpt_lib.latest_checkpoint(str(tmp_path / "r")), st_s)
+        for b in jax.device_get(st_s.round_opt):
+            for m in ("mu", "nu"):
+                assert np.array_equal(
+                    np.asarray(jax.device_get(st_s.round_opt)[b][m]),
+                    np.asarray(jax.device_get(got_s.round_opt)[b][m]))
+
+    def test_pre_tracker_checkpoint_restores_zero_moments(self, mesh8,
+                                                          tmp_path):
+        _eng, st = self._state_with_tracker(mesh8, "sharded")
+        legacy = st.replace(round_opt=None)   # a pre-ISSUE-9 layout
+        ckpt_lib.save_checkpoint(str(tmp_path / "l"), legacy, 3)
+        got, ep = ckpt_lib.restore_checkpoint(
+            ckpt_lib.latest_checkpoint(str(tmp_path / "l")), st)
+        assert ep == 3
+        for leaf in jax.tree_util.tree_leaves(got.round_opt):
+            assert np.all(np.asarray(leaf) == 0)
+
+
+class TestElasticReshardRoundOpt:
+    def _host_state(self, placement, n=4):
+        pw = per_worker_shapes()
+        rng = np.random.default_rng(3)
+        params = {k: rng.normal(size=(n, *s)).astype(np.float32)
+                  for k, s in SHAPES.items()}
+        trk = jax.device_get(comms.round_opt_init(
+            pw, n, placement=placement, bucket_bytes=TINY_BUCKET))
+        plan = comms.bucket_plan(list(pw.values()), n, TINY_BUCKET)
+        for i, b in enumerate(plan):
+            filled = sum(s for (_i, _o, s) in b.items)
+            vec = np.zeros(b.padded, np.float32)
+            vec[:filled] = rng.normal(size=filled)
+            for m in ("mu", "nu"):
+                trk[comms._bucket_name(i)][m] = (
+                    vec.reshape(n, -1) if placement == "sharded"
+                    else np.broadcast_to(vec, (n, b.padded)).copy())
+        return TrainState(
+            params=params, batch_stats={},
+            opt_state={"mu": jax.tree_util.tree_map(np.zeros_like,
+                                                    params)},
+            lr_epoch=np.zeros((n,), np.int32),
+            rng=np.zeros((n, 2), np.uint32),
+            round_opt=trk)
+
+    @pytest.mark.parametrize("placement", ["replicated", "sharded"])
+    def test_kill_join_retiles_the_tracker(self, placement):
+        host = self._host_state(placement)
+        out = elastic_lib.reshard_state(
+            host, kept_positions=[0, 2, 3], joiner_ids=[4], seed=0,
+            round_opt_placement=placement, sync_bucket_bytes=TINY_BUCKET)
+        # per-worker rows re-tiled for the SAME worker count: vectors
+        # must be preserved exactly (kill+join is a swap, n unchanged)
+        for b in host.round_opt:
+            for m in ("mu", "nu"):
+                a, c = host.round_opt[b][m], out.round_opt[b][m]
+                if placement == "sharded":
+                    assert np.array_equal(np.asarray(a).reshape(-1),
+                                          np.asarray(c).reshape(-1))
+                else:
+                    assert np.array_equal(np.asarray(a)[0],
+                                          np.asarray(c)[0])
+        # survivors' per-worker state row-edited as before
+        np.testing.assert_array_equal(
+            out.params["a"][:3], host.params["a"][[0, 2, 3]])
+
+    def test_shrink_then_grow_roundtrips(self):
+        host = self._host_state("sharded", n=4)
+        down = elastic_lib.reshard_state(
+            host, kept_positions=[0, 1, 2], joiner_ids=[], seed=0,
+            round_opt_placement="sharded", sync_bucket_bytes=TINY_BUCKET)
+        back = elastic_lib.reshard_state(
+            down, kept_positions=[0, 1, 2], joiner_ids=[5], seed=0,
+            round_opt_placement="sharded", sync_bucket_bytes=TINY_BUCKET)
+        for b in host.round_opt:
+            for m in ("mu", "nu"):
+                assert np.array_equal(
+                    np.asarray(host.round_opt[b][m]).reshape(-1),
+                    np.asarray(back.round_opt[b][m]).reshape(-1)), (b, m)
+
+    def test_missing_layout_kwargs_raise(self):
+        host = self._host_state("sharded")
+        with pytest.raises(ValueError, match="round_opt_placement"):
+            elastic_lib.reshard_state(host, kept_positions=[0, 1],
+                                      joiner_ids=[], seed=0)
+
+
+# ----------------------------------------------------------------------
+# Driver e2e composition (slow: each case is two full train_global runs)
+# ----------------------------------------------------------------------
+
+def _e2e_cfg(**kw):
+    base = dict(model="mlp", dataset="mnist", epochs_global=5,
+                epochs_local=1, batch_size=16, limit_train_samples=400,
+                limit_eval_samples=100, compute_dtype="float32",
+                augment=False, seed=1, num_workers=4,
+                sync_mode="sharded", sync_bucket_mb=0.001)
+    base.update(kw)
+    return Config(**base)
+
+
+PROBE4 = np.array([1.0, 1.5, 1.0, 2.0])
+
+TAIL_KEYS = ("global_train_losses", "global_val_losses",
+             "global_train_accuracies", "global_val_accuracies",
+             "step_caps", "shard_sizes")
+
+
+@pytest.mark.slow
+class TestElasticCompose:
+    """ISSUE 9 satellite: kill+join THROUGH a sharded-optimizer run keeps
+    the PR 8 bitwise-trajectory gate, sanitized."""
+
+    def test_weights_sharded_placement_keeps_the_bitwise_gate(self):
+        kw = dict(chaos="kill@2:w1,join@2", sanitize=True,
+                  opt_placement="sharded", aggregation_by="weights")
+        walls = lambda e: np.ones(4)
+        full = train_global(_e2e_cfg(**kw), progress=False,
+                            simulated_durations=PROBE4,
+                            simulated_round_durations=walls)
+        assert full["sync_engine"]["mode"] == "sharded"
+        assert full["sync_engine"]["opt_placement"] == "sharded"
+        assert len(full["elastic"]["events"]) == 2
+        assert full["sanitize"]["retrace_count"] == 0
+        snap = full["elastic"]["snapshots"][0]
+        fresh = train_global(_e2e_cfg(**kw), progress=False,
+                             simulated_durations=PROBE4,
+                             simulated_round_durations=walls,
+                             elastic_snapshot=snap)
+        for k in TAIL_KEYS:
+            assert full[k][2:] == fresh[k], f"results[{k!r}] diverged"
+
+    def test_gradients_tracker_survives_kill_join_bitwise(self):
+        kw = dict(chaos="kill@2:w1,join@2", sanitize=True,
+                  opt_placement="sharded", aggregation_by="gradients")
+        walls = lambda e: np.ones(4)
+        full = train_global(_e2e_cfg(**kw), progress=False,
+                            simulated_durations=PROBE4,
+                            simulated_round_durations=walls)
+        assert full["sanitize"]["retrace_count"] == 0
+        assert full["state"].round_opt is not None
+        snap = full["elastic"]["snapshots"][0]
+        # the snapshot carries the re-tiled tracker for the new roster
+        assert snap.host_state.round_opt is not None
+        fresh = train_global(_e2e_cfg(**kw), progress=False,
+                             simulated_durations=PROBE4,
+                             simulated_round_durations=walls,
+                             elastic_snapshot=snap)
+        for k in TAIL_KEYS:
+            assert full[k][2:] == fresh[k], f"results[{k!r}] diverged"
+        # and the final tracker state itself is bitwise across the pair
+        for a, b in zip(
+                jax.tree_util.tree_leaves(
+                    jax.device_get(full["state"].round_opt)),
+                jax.tree_util.tree_leaves(
+                    jax.device_get(fresh["state"].round_opt))):
+            assert np.array_equal(np.asarray(a), np.asarray(b))

@@ -283,6 +283,15 @@ class TestDriverTelemetry:
         for t in res["round_timings"]:
             assert t["sync_mode"] == "sharded"
             assert t["sync_bytes"] > 0
+        # run-artifact engine provenance (ISSUE 9 satellite): sync mode,
+        # resolved optimizer placement, and measured per-worker resident
+        # bytes for every state component
+        se = res["sync_engine"]
+        assert se["mode"] == "sharded"
+        assert se["opt_placement"] == "sharded"   # auto follows the engine
+        pw = se["per_worker_state_bytes"]
+        assert pw["params"] > 0 and pw["opt_state"] > 0
+        assert pw["ef_residual"] == 0 and pw["round_opt"] == 0
         assert res["compile_cache"]["enabled"] is False
         import os
         if not os.environ.get("JAX_GRAFT_TEST_COMPILE_CACHE"):
@@ -320,6 +329,15 @@ class TestBenchEntry:
         assert out["compressed"]["wire_mb"] == pytest.approx(
             out["sharded"]["wire_mb"] / 2, rel=0.01)
         assert out["compressed_max_abs_err"] < 0.05
+        # optimizer-placement axis (ISSUE 9): per-worker opt-state bytes
+        # at exactly 1/N of replicated, both placements bitwise
+        pl = out["opt_placement"]
+        assert pl["opt_state_bytes_ratio"] == pl["expected_opt_state_ratio"]
+        assert pl["bitwise_sharded_eq_replicated"] is True
+        assert pl["tracker_bitwise_consistent"] is True
+        for row in ("replicated", "sharded"):
+            assert pl[row]["ms"] > 0
+            assert pl[row]["opt_state_mb_per_worker"] > 0
 
 
 class TestInt8Compressed:

@@ -390,4 +390,53 @@ if [ "$rc" -ne 0 ]; then
   exit "$rc"
 fi
 
+# Shard-resident optimizer smoke (ISSUE 9): a 2-worker CPU run of the
+# SAME sanitized weights-mode config under --opt_placement replicated vs
+# sharded — the round-boundary apply moves from the post-gather
+# full-size twin onto the 1/N psum_scatter shard, and the final params
+# must be BITWISE identical (the fp32 placement gate, through the real
+# driver).  A third gradients-mode run checks the round-optimizer
+# moments actually land sharded: per-worker round_opt bytes at exactly
+# 1/2 of the replicated layout on the 2-worker mesh.
+echo "== opt-placement smoke (2-worker sharded vs replicated, sanitized) =="
+XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+  JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+import jax
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+
+kw = dict(model="mlp", dataset="mnist", epochs_global=2, epochs_local=1,
+          batch_size=16, limit_train_samples=256, limit_eval_samples=64,
+          compute_dtype="float32", augment=False, seed=7, num_workers=2,
+          sync_mode="sharded", sanitize=True)
+runs = {}
+for pl in ("replicated", "sharded"):
+    res = train_global(Config(aggregation_by="weights", opt_placement=pl,
+                              **kw), progress=False)
+    assert res["sync_engine"]["opt_placement"] == pl, res["sync_engine"]
+    assert res["sanitize"]["retrace_count"] == 0
+    assert res["sanitize"]["transfer_guard_violations"] == 0
+    runs[pl] = jax.device_get(res["state"].params)
+for a, b in zip(jax.tree_util.tree_leaves(runs["replicated"]),
+                jax.tree_util.tree_leaves(runs["sharded"])):
+    assert np.array_equal(np.asarray(a), np.asarray(b)), \
+        "sharded apply diverged from the replicated twin"
+byt = {}
+for pl in ("replicated", "sharded"):
+    res = train_global(Config(aggregation_by="gradients", opt_placement=pl,
+                              **kw), progress=False)
+    byt[pl] = res["sync_engine"]["per_worker_state_bytes"]["round_opt"]
+    assert byt[pl] > 0, res["sync_engine"]
+assert byt["replicated"] == 2 * byt["sharded"], byt
+print("opt-placement smoke OK: fp32 sharded apply bitwise == replicated,"
+      f" per-worker round_opt bytes {byt['sharded']} vs"
+      f" {byt['replicated']} (1/2)")
+EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "opt-placement smoke FAILED (rc=$rc)"
+  exit "$rc"
+fi
+
 echo "verify OK"
