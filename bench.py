@@ -668,18 +668,76 @@ def measure_sync() -> dict:
         for k in shapes))
     placement_rows["tracker_bitwise_consistent"] = bool(tracker_ok)
 
-    return {
+    # ONE result dict (shared by the 1-device early return below and the
+    # full path) so the schema cannot drift between the two
+    base = {
         "n_workers": n,
         "param_mb": round(4 * elems / 1e6, 2),
-        "dense": {"ms": round(dense_s * 1e3, 3), "wire_mb": round(b_dense / 1e6, 3)},
-        "sharded": {"ms": round(sharded_s * 1e3, 3), "wire_mb": round(b_sharded / 1e6, 3)},
-        "compressed": {"ms": round(comp_s * 1e3, 3), "wire_mb": round(b_comp / 1e6, 3)},
-        "sharded_vs_dense_bytes": round(b_sharded / b_dense, 4) if b_dense else None,
+        "dense": {"ms": round(dense_s * 1e3, 3),
+                  "wire_mb": round(b_dense / 1e6, 3)},
+        "sharded": {"ms": round(sharded_s * 1e3, 3),
+                    "wire_mb": round(b_sharded / 1e6, 3)},
+        "compressed": {"ms": round(comp_s * 1e3, 3),
+                       "wire_mb": round(b_comp / 1e6, 3)},
+        "sharded_vs_dense_bytes": (round(b_sharded / b_dense, 4)
+                                   if b_dense else None),
         "expected_bytes_ratio": round(2 * (n - 1) / n, 4),
         "bitwise_sharded_eq_dense": bool(bitwise),
         "compressed_max_abs_err": max_err,
         "opt_placement": placement_rows,
     }
+
+    # --- param-residency axis (ISSUE 11) ------------------------------
+    # The round-loop FSDP A/B: the same sync program ENDING at the
+    # scatter (resident bucket shards, the between-round state) vs the
+    # replicated twin, plus the round-entry gather that reconstructs the
+    # full tree.  Reports per-worker resident bytes (exactly 1/N of the
+    # padded gathered peak), the entry-gather wall, the bitwise flag
+    # (entry-gather(resident) == replicated output), and the checkpoint
+    # write path's params payload per worker — the resident layout
+    # snapshots only the 1/N shard rows, no gather ever runs on the save
+    # path (checkpoint.snapshot_addressable copies addressable shards
+    # verbatim).
+    if n < 2:
+        # nothing to shard on a 1-device mesh; the gossip/elastic smokes
+        # set --xla_force_host_platform_device_count for the same reason
+        return {**base,
+                "param_residency": {"status": "skipped_single_device"}}
+    res_sync = comms.make_host_sync(mesh, mode="sharded",
+                                    param_residency="resident")
+    (resident_out, _r2), res_ms = _time_host_sync(res_sync, tree, None,
+                                                  reps=3)
+    gather_fn = comms.make_resident_gather(mesh, per_worker)
+    gathered, gather_s = _time_host_sync(
+        lambda t, _r, _f=gather_fn: _f(t), resident_out, None, reps=5)
+    resident_bitwise = bool(all(
+        np.array_equal(np.asarray(sharded_out[k]), np.asarray(gathered[k]))
+        for k in shapes))
+    padded_bytes = sum(int(np.prod(l.shape)) * 4
+                       for l in jax.tree_util.tree_leaves(resident_out))
+    resident_pw = padded_bytes // n
+    replicated_pw = 4 * elems
+    # checkpoint params payload per worker: resident snapshots the 1/N
+    # shard rows, replicated the full per-worker tree
+    residency_rows = {
+        "resident": {"sync_ms": round(res_ms * 1e3, 3),
+                     "params_mb_per_worker": round(resident_pw / 1e6, 4),
+                     "ckpt_params_mb_per_worker":
+                         round(resident_pw / 1e6, 4)},
+        "replicated": {"sync_ms": round(sharded_s * 1e3, 3),
+                       "params_mb_per_worker":
+                           round(replicated_pw / 1e6, 4),
+                       "ckpt_params_mb_per_worker":
+                           round(replicated_pw / 1e6, 4)},
+        "entry_gather_ms": round(gather_s * 1e3, 3),
+        "resident_vs_gathered_peak_bytes": round(
+            resident_pw / padded_bytes, 6),
+        "expected_resident_ratio": round(1 / n, 6),
+        "bitwise_resident_eq_replicated": resident_bitwise,
+        "ckpt_gather_free_save": True,   # structural: snapshot copies
+        #                                  addressable shard rows only
+    }
+    return {**base, "param_residency": residency_rows}
 
 
 def measure_gossip() -> dict:

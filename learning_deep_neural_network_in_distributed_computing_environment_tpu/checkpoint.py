@@ -616,7 +616,8 @@ def host_tree(path: str) -> tuple[dict[str, np.ndarray], int]:
     return out, int(manifest["global_epoch"])
 
 
-def restore_checkpoint(path: str, state_template):
+def restore_checkpoint(path: str, state_template, *,
+                       params_template=None, bucket_bytes: int | None = None):
     """Restore ``(state, global_epoch)`` from a checkpoint path.
 
     ``path`` is a committed sharded directory (format 2) or a legacy
@@ -624,10 +625,24 @@ def restore_checkpoint(path: str, state_template):
     provides the pytree structure/shapes AND the target shardings: each
     restored host array is ``device_put`` onto its template leaf's
     sharding, so resuming on a different mesh/host-count re-shards
-    cleanly instead of leaving host numpy in the tree."""
+    cleanly instead of leaving host numpy in the tree.
+
+    Cross-residency restore (ISSUE 11): a checkpoint saved with
+    scatter-resident params (``.params_resident`` bucket rows — the PR 5
+    shard files ARE the 1/N storage unit, no gather ever ran on the save
+    path) restores into a replicated template and vice versa; a
+    pre-ISSUE-11 (replicated) checkpoint restores into a resident run
+    unchanged.  Both directions are exact re-layouts of the same
+    consensus vector.  ``params_template`` (per-worker ShapeDtypeStructs,
+    the engine's) is required for the replicated->resident direction —
+    bucket rows carry no leaf shapes; ``bucket_bytes`` defaults to the
+    manifest's recorded ``sync_bucket_mb`` and then the engine default."""
     if os.path.isdir(path):
         merged, epoch = host_tree(path)
         flat, treedef = jax.tree_util.tree_flatten_with_path(state_template)
+        merged = _relayout_params_residency(
+            path, merged, flat, params_template=params_template,
+            bucket_bytes=bucket_bytes)
         leaves = []
         for kpath, tmpl in flat:
             key = jax.tree_util.keystr(kpath)
@@ -675,6 +690,106 @@ def restore_checkpoint(path: str, state_template):
         {"state": state_template, "global_epoch": 0}, data)
     state = jax.tree.map(_reshard_leaf, state_template, payload["state"])
     return state, int(payload["global_epoch"])
+
+
+def _relayout_params_residency(path: str, merged: dict, tmpl_flat,
+                               *, params_template=None,
+                               bucket_bytes: int | None = None) -> dict:
+    """Re-lay checkpointed params across residency modes (ISSUE 11).
+
+    ``merged`` is the host-merged leaf dict; ``tmpl_flat`` the restore
+    template's ``(path, leaf)`` list.  When the checkpoint and template
+    agree on residency this is the identity.  Otherwise the consensus
+    vector is reconstructed and re-laid out exactly:
+
+    - resident on disk -> replicated template: concatenate each bucket's
+      shard rows (the gather, on host), slice the leaves out by the
+      bucket plan over the template's own params shapes, and tile each
+      to the worker-stacked consensus rows;
+    - replicated on disk (incl. pre-ISSUE-11 checkpoints) -> resident
+      template: verify every params leaf's rows are identical (only a
+      weights x equal consensus state can become resident), pack row 0
+      into the resident bucket layout (``comms.resident_from_tree``).
+      Needs ``params_template`` — resident bucket rows carry no leaf
+      shapes, and a resident restore template has no params tree.
+
+    The bucket size comes from the direction's authoritative side: the
+    manifest's recorded ``sync_bucket_mb`` for interpreting a resident
+    checkpoint, the restoring engine's ``bucket_bytes`` for building a
+    resident template layout (each falls back to the other, then the
+    engine default)."""
+    from . import comms
+
+    ckpt_resident = any(k.startswith(".params_resident") for k in merged)
+    tmpl_resident = any(
+        jax.tree_util.keystr(p).startswith(".params_resident")
+        for p, _t in tmpl_flat)
+    if ckpt_resident == tmpl_resident:
+        return merged
+    meta_mb = manifest_metadata(path).get("sync_bucket_mb")
+    meta_bytes = int(float(meta_mb) * (1 << 20)) if meta_mb else None
+    out = dict(merged)
+    if ckpt_resident:
+        bb = meta_bytes or bucket_bytes or comms.DEFAULT_BUCKET_BYTES
+        p_items = [(jax.tree_util.keystr(p), t) for p, t in tmpl_flat
+                   if jax.tree_util.keystr(p).startswith(".params[")]
+        if not p_items:
+            raise ValueError(
+                f"checkpoint {path} carries scatter-resident params but "
+                "the restore template has neither a params tree nor a "
+                "params_resident layout")
+        n = int(np.shape(p_items[0][1])[0])
+        leaves = [jax.ShapeDtypeStruct(tuple(np.shape(t)[1:]),
+                                       np.dtype(t.dtype))
+                  for _k, t in p_items]
+        for i, b in enumerate(comms.bucket_plan(leaves, n, bb)):
+            key = f".params_resident['{comms._bucket_name(i)}']"
+            if key not in out:
+                raise ValueError(
+                    f"checkpoint {path} resident layout has no bucket "
+                    f"leaf {key} (saved with a different sync_bucket_mb "
+                    "than the manifest records?)")
+            vec = np.asarray(out.pop(key)).reshape(-1)
+            if vec.size != b.padded:
+                raise ValueError(
+                    f"checkpoint resident bucket {key} carries "
+                    f"{vec.size} elements, expected {b.padded} "
+                    "(different sync_bucket_mb or worker count?)")
+            for (j, off, size) in b.items:
+                k, t = p_items[j]
+                row = vec[off:off + size].reshape(
+                    np.shape(t)[1:]).astype(np.dtype(t.dtype))
+                # the consensus IS every worker's value
+                out[k] = np.broadcast_to(row[None], np.shape(t)).copy()
+        return out
+    bb = bucket_bytes or meta_bytes or comms.DEFAULT_BUCKET_BYTES
+    if params_template is None:
+        raise ValueError(
+            f"checkpoint {path} stores replicated params but the restore "
+            "template is scatter-resident: pass params_template= (the "
+            "engine's per-worker ShapeDtypeStructs) so the resident "
+            "bucket layout can be rebuilt")
+    pt_flat, pt_def = jax.tree_util.tree_flatten_with_path(params_template)
+    vals, n = [], None
+    for p, _t in pt_flat:
+        key = ".params" + jax.tree_util.keystr(p)
+        if key not in out:
+            raise ValueError(
+                f"checkpoint {path} has no params leaf {key} needed to "
+                "build the resident layout (engine config mismatch?)")
+        arr = np.asarray(out.pop(key))
+        n = int(arr.shape[0])
+        if not np.array_equal(arr, np.broadcast_to(arr[:1], arr.shape)):
+            raise ValueError(
+                f"checkpoint leaf {key} rows differ across workers: only "
+                "a consensus state (weights x equal aggregation) can "
+                "restore into the scatter-resident layout")
+        vals.append(arr[0])
+    resident = comms.resident_from_tree(
+        jax.tree_util.tree_unflatten(pt_def, vals), n, bucket_bytes=bb)
+    for name, rows in resident.items():
+        out[f".params_resident['{name}']"] = rows
+    return out
 
 
 def _relayout_round_opt(key: str, val: np.ndarray,

@@ -250,7 +250,8 @@ def sharded_sync(tree: PyTree, *, how: str = "equal",
                  local_weight: float = 0.5, axis_name: str = DATA_AXIS,
                  wire_dtype=None, residual: PyTree | None = None,
                  bucket_bytes: int = DEFAULT_BUCKET_BYTES,
-                 opt_placement: str = "sharded"
+                 opt_placement: str = "sharded",
+                 residency: str = "replicated"
                  ) -> tuple[PyTree, PyTree | None]:
     """Sharded all-reduce aggregation of a per-worker pytree.
 
@@ -289,7 +290,8 @@ def sharded_sync(tree: PyTree, *, how: str = "equal",
     synced, new_res, _ = sharded_opt_sync(
         tree, how=how, local_weight=local_weight, axis_name=axis_name,
         wire_dtype=wire_dtype, residual=residual,
-        bucket_bytes=bucket_bytes, opt_placement=opt_placement)
+        bucket_bytes=bucket_bytes, opt_placement=opt_placement,
+        residency=residency)
     return synced, new_res
 
 
@@ -381,15 +383,206 @@ def round_opt_relayout(tracker: dict, per_worker_tree: PyTree, n_new: int,
     return out
 
 
+# ----------------------------------------------------------------------
+# Scatter-resident consensus params (ISSUE 11): the between-round
+# parameter layout of the round-loop FSDP scheme.  One bucket of the
+# sync engine's plan maps to one [n, padded // n] array whose row w is
+# worker w's contiguous 1/N shard of the packed consensus vector — the
+# exact psum_scatter output layout, which is what lets the sync END at
+# the scatter (apply on the shard, no trailing all_gather) and the NEXT
+# round's entry gather reconstruct the full tree bit-for-bit.  Padding
+# positions carry exactly-zero values (the padded mean is zero every
+# round), so re-tiling for a new worker count is exact — the same
+# invariant the round-optimizer tracker relies on.
+# ----------------------------------------------------------------------
+
+PARAM_RESIDENCIES = ("replicated", "resident")
+
+
+def resident_from_tree(per_worker_tree: PyTree, n: int, *,
+                       bucket_bytes: int = DEFAULT_BUCKET_BYTES) -> dict:
+    """HOST: pack one worker's CONSENSUS params into the resident layout.
+
+    ``per_worker_tree`` holds the shared consensus values (equal-blend
+    weights mode: every worker's post-sync params are identical, so any
+    row is the consensus).  Returns ``{bucket: [n, padded // n]}`` numpy
+    arrays — row w is worker w's shard.  Used at engine init (broadcast
+    init IS a consensus) and by the cross-residency checkpoint/elastic
+    re-layouts."""
+    import numpy as np
+
+    leaves = jax.tree_util.tree_leaves(per_worker_tree)
+    out: dict = {}
+    for i, b in enumerate(bucket_plan(leaves, n, bucket_bytes)):
+        parts = [np.asarray(leaves[j]).reshape(-1).astype(b.dtype)
+                 for (j, _off, _size) in b.items]
+        vec = np.concatenate(parts) if len(parts) > 1 else parts[0]
+        pad = b.padded - vec.size
+        if pad:
+            vec = np.concatenate([vec, np.zeros(pad, vec.dtype)])
+        out[_bucket_name(i)] = vec.reshape(n, b.padded // n)
+    return out
+
+
+def resident_to_tree(resident: dict, per_worker_template: PyTree, *,
+                     bucket_bytes: int = DEFAULT_BUCKET_BYTES) -> PyTree:
+    """HOST: unpack a resident layout back into the consensus tree.
+
+    The host twin of the round-entry device gather — concatenating the
+    shard rows IS the all_gather (pure data movement, bit-exact), so
+    final-eval / checkpoint-relayout consumers reconstruct exactly the
+    tree the round program would have gathered.  The worker count is
+    read off the rows."""
+    import numpy as np
+
+    leaves, treedef = jax.tree_util.tree_flatten(per_worker_template)
+    n = None
+    for arr in resident.values():
+        n = int(np.shape(arr)[0])
+        break
+    if not n:
+        raise ValueError("resident params layout is empty")
+    out: list = [None] * len(leaves)
+    plan = bucket_plan(leaves, n, bucket_bytes)
+    for i, b in enumerate(plan):
+        name = _bucket_name(i)
+        if name not in resident:
+            raise ValueError(
+                f"resident params layout has no bucket {name} "
+                f"({len(resident)} buckets vs plan {len(plan)})")
+        arr = np.asarray(resident[name])
+        if arr.shape != (n, b.padded // n):
+            raise ValueError(
+                f"resident params bucket {name} has shape {arr.shape}, "
+                f"expected {(n, b.padded // n)} (sync_bucket_mb or "
+                "worker count changed since the state was built?)")
+        vec = arr.reshape(-1)
+        for (j, off, size) in b.items:
+            out[j] = vec[off:off + size].reshape(
+                np.shape(leaves[j])).astype(np.dtype(leaves[j].dtype))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def resident_relayout(resident: dict, per_worker_template: PyTree,
+                      n_new: int, *,
+                      bucket_bytes: int = DEFAULT_BUCKET_BYTES) -> dict:
+    """Re-tile a HOST resident params layout for a new worker count
+    (elastic membership change, ISSUE 11).
+
+    The consensus vector is worker-invariant, so the re-layout mirrors
+    ``round_opt_relayout``: reconstruct the vector from the shard rows,
+    re-pad for the new bucket tiling (pad positions carry exactly-zero
+    values — the padded mean is zero every round — so trimming or
+    extending the pad is exact), and re-split."""
+    import numpy as np
+
+    leaves = jax.tree_util.tree_leaves(per_worker_template)
+    plan = bucket_plan(leaves, max(1, n_new), bucket_bytes)
+    out: dict = {}
+    for i, b in enumerate(plan):
+        name = _bucket_name(i)
+        if name not in resident:
+            raise ValueError(
+                f"resident params layout has no bucket {name} "
+                f"({len(resident)} buckets vs plan {len(plan)})")
+        vec = np.asarray(resident[name]).reshape(-1)
+        filled = sum(size for (_j, _off, size) in b.items)
+        if vec.size < filled:
+            raise ValueError(
+                f"resident params bucket {name} carries {vec.size} "
+                f"elements but the plan needs {filled}")
+        vec = vec[:filled]
+        pad = b.padded - filled
+        if pad:
+            vec = np.concatenate([vec, np.zeros(pad, vec.dtype)])
+        out[name] = vec.reshape(n_new, b.padded // n_new)
+    return out
+
+
+def resident_gather(shards: dict, per_worker_template: PyTree, *,
+                    axis_name: str = DATA_AXIS,
+                    bucket_bytes: int = DEFAULT_BUCKET_BYTES) -> PyTree:
+    """The round-entry gather (ISSUE 11 tentpole): inside ``shard_map``,
+    all_gather each bucket's resident shard row over the worker axis and
+    unpack the full consensus tree.
+
+    ``shards`` holds this worker's squeezed per-worker rows
+    (``[padded // n]`` per bucket); the gathered full buffers are
+    transient compute-scope values — XLA frees them with the program, so
+    the RESIDENT state never exceeds 1/N per worker.  Bit-exactness: the
+    gather concatenates the same shard values the sync's trailing
+    all_gather used to move, so entry-gather(exit-scatter) reproduces
+    the replicated twin's tree bit-for-bit."""
+    leaves, treedef = jax.tree_util.tree_flatten(per_worker_template)
+    n = axis_size(axis_name)
+    out: list = [None] * len(leaves)
+    plan = bucket_plan(leaves, n, bucket_bytes)
+    for i, b in enumerate(plan):
+        name = _bucket_name(i)
+        if name not in shards:
+            raise ValueError(
+                f"resident params layout has no bucket {name} "
+                f"({len(shards)} buckets vs plan {len(plan)})")
+        row = shards[name]
+        if tuple(row.shape) != (b.padded // n,):
+            raise ValueError(
+                f"resident params bucket {name} row has shape "
+                f"{tuple(row.shape)}, expected {(b.padded // n,)} "
+                "(sync_bucket_mb or worker count changed?)")
+        full = lax.all_gather(row, axis_name, tiled=True)
+        for (j, off, size) in b.items:
+            leaf = leaves[j]
+            out[j] = full[off:off + size].reshape(leaf.shape).astype(
+                leaf.dtype)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def make_resident_gather(mesh, per_worker_template: PyTree, *,
+                         bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+                         donate: bool = False):
+    """Jitted stand-alone round-entry gather over a worker-stacked
+    resident layout (tests / bench A/Bs): takes ``{bucket:
+    [n, padded // n]}`` and returns the worker-stacked full tree
+    ([n, ...] leaves).  ``donate=True`` donates the resident input —
+    the engine's enter program shape."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(DATA_AXIS)
+
+    def _gather(shards):
+        def inner(sh):
+            sq = jax.tree_util.tree_map(lambda x: x[0], sh)
+            tree = resident_gather(sq, per_worker_template,
+                                   bucket_bytes=bucket_bytes)
+            return jax.tree_util.tree_map(lambda x: x[None], tree)
+        return shard_map(inner, mesh=mesh, in_specs=(spec,),
+                         out_specs=spec)(shards)
+
+    return jax.jit(_gather, donate_argnums=(0,) if donate else ())
+
+
 def sharded_opt_sync(tree: PyTree, *, how: str = "equal",
                      local_weight: float = 0.5, axis_name: str = DATA_AXIS,
                      wire_dtype=None, residual: PyTree | None = None,
                      bucket_bytes: int = DEFAULT_BUCKET_BYTES,
                      opt_placement: str = "sharded",
-                     tracker: dict | None = None
+                     tracker: dict | None = None,
+                     residency: str = "replicated"
                      ) -> tuple[PyTree, PyTree | None, dict | None]:
     """``sharded_sync`` with the full apply-stage surface (ISSUE 9):
     optimizer placement plus the round-level Adam moment tracker.
+
+    ``residency`` (ISSUE 11) places the sync's OUTPUT: ``"replicated"``
+    all_gathers the post-apply values home (the full synced tree on
+    every worker, as always); ``"resident"`` ENDS the program at the
+    scatter — the first return value is then the ``{bucket:
+    [padded // n]}`` resident shard layout (this worker's decoded
+    post-apply shard), the trailing all_gather is gone, and the next
+    round's ``resident_gather`` reconstructs the full tree bit-for-bit
+    at entry.  Resident output requires the equal blend on the sharded
+    placement: the weighted blend's own-term is irreducibly per-worker
+    and a replicated apply has no shard-side output (config.py resolves
+    the combinations eagerly).
 
     ``tracker`` (per-worker slices of a ``round_opt_init`` tree, i.e.
     already squeezed inside shard_map) updates Adam moments of the
@@ -407,9 +600,26 @@ def sharded_opt_sync(tree: PyTree, *, how: str = "equal",
         raise ValueError(
             f"opt_placement must be one of {OPT_PLACEMENTS}, got "
             f"{opt_placement!r}")
+    if residency not in PARAM_RESIDENCIES:
+        raise ValueError(
+            f"residency must be one of {PARAM_RESIDENCIES}, got "
+            f"{residency!r}")
+    resident = residency == "resident"
+    if resident and (how != "equal" or opt_placement != "sharded"):
+        raise ValueError(
+            "a scatter-resident output requires the equal blend on the "
+            "sharded placement: the weighted own-term blend is "
+            "irreducibly per-worker and a replicated apply produces no "
+            f"shard-side output (got how={how!r}, "
+            f"opt_placement={opt_placement!r}; config.py resolves these "
+            "combinations to the replicated residency)")
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     n = axis_size(axis_name)
     if not leaves or n == 1:
+        if resident:
+            raise ValueError(
+                "a scatter-resident output needs a worker axis of size "
+                ">= 2 and a non-empty tree (nothing to shard)")
         return tree, residual, tracker
     res_leaves = None
     if residual is not None:
@@ -426,6 +636,7 @@ def sharded_opt_sync(tree: PyTree, *, how: str = "equal",
             "the scale-then-encode apply onto the shard: opt_placement "
             f"must be 'sharded', got {opt_placement!r}")
     new_tracker: dict | None = {} if tracker is not None else None
+    resident_out: dict = {}
     out: list = [None] * len(leaves)
     new_res: list | None = [None] * len(leaves) if res_leaves is not None \
         else None
@@ -514,7 +725,17 @@ def sharded_opt_sync(tree: PyTree, *, how: str = "equal",
                     err = err + lax.dynamic_update_slice(
                         jnp.zeros((b.padded,), jnp.float32), n * e2,
                         (lax.axis_index(axis_name) * (b.padded // n),))
-                full = gather_decoded(mean, mean_scale)
+                if resident:
+                    # ISSUE 11: the program ENDS at the scatter — the
+                    # decoded post-apply shard IS the between-round
+                    # state, and next round's entry gather concatenates
+                    # exactly these values (what gather_decoded would
+                    # have produced), so the handoff is bit-exact even
+                    # on a compressed wire
+                    resident_out[_bucket_name(bi)] = mean32_dec
+                    full = None
+                else:
+                    full = gather_decoded(mean, mean_scale)
                 track32 = mean32
         else:
             # weighted needs the per-worker OWN value elementwise, so the
@@ -556,13 +777,16 @@ def sharded_opt_sync(tree: PyTree, *, how: str = "equal",
                 "nu": ROUND_ADAM_B2 * nu + (1.0 - ROUND_ADAM_B2) * (g * g)}
         for (i, off, size) in b.items:
             leaf = leaves[i]
-            out[i] = full[off:off + size].reshape(leaf.shape).astype(
-                leaf.dtype)
+            if full is not None:
+                out[i] = full[off:off + size].reshape(leaf.shape).astype(
+                    leaf.dtype)
             if new_res is not None:
                 new_res[i] = err[off:off + size].reshape(leaf.shape)
-    synced = jax.tree_util.tree_unflatten(treedef, out)
     res_out = (residual if new_res is None
                else jax.tree_util.tree_unflatten(treedef, new_res))
+    if resident:
+        return resident_out, res_out, new_tracker
+    synced = jax.tree_util.tree_unflatten(treedef, out)
     return synced, res_out, new_tracker
 
 
@@ -715,7 +939,8 @@ def make_host_sync(mesh, *, mode: str = "sharded", how: str = "equal",
                    bucket_bytes: int = DEFAULT_BUCKET_BYTES,
                    topology: str = "allreduce",
                    opt_placement: str = "sharded",
-                   track_opt: bool = False):
+                   track_opt: bool = False,
+                   param_residency: str = "replicated"):
     """Jitted stand-alone round sync over worker-stacked pytrees.
 
     The sync-engine twin of ``make_host_aggregator`` (tests, bench A/Bs,
@@ -734,9 +959,19 @@ def make_host_sync(mesh, *, mode: str = "sharded", how: str = "equal",
     through the program — the returned callable then takes
     ``(tree, residual, tracker)`` and returns
     ``(synced, new_residual, new_tracker)``.
+
+    ``param_residency="resident"`` (ISSUE 11, sharded mode only) ends
+    the program at the scatter: the first return value is the
+    worker-stacked resident layout (``{bucket: [n, padded // n]}``)
+    instead of the synced tree — feed it to ``make_resident_gather`` to
+    reconstruct the full tree bit-for-bit.
     """
     from jax.sharding import PartitionSpec as P
 
+    if param_residency == "resident" and mode != "sharded":
+        raise ValueError(
+            "param_residency 'resident' is a sharded-engine output "
+            f"layout; mode {mode!r} has no scatter to end at")
     spec = P(DATA_AXIS)
 
     def _sync(tree, residual, tracker):
@@ -761,7 +996,8 @@ def make_host_sync(mesh, *, mode: str = "sharded", how: str = "equal",
                     t, how=how, local_weight=local_weight,
                     wire_dtype=wire_dtype, residual=r,
                     bucket_bytes=bucket_bytes,
-                    opt_placement=opt_placement, tracker=new_t)
+                    opt_placement=opt_placement, tracker=new_t,
+                    residency=param_residency)
             return ex(out), ex(new_r), ex(new_t)
         return shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
                          out_specs=(spec, spec, spec))(

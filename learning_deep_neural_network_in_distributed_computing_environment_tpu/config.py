@@ -193,6 +193,29 @@ class Config:
     # see docs/ARCHITECTURE.md.  In fp32 the two placements are
     # bit-identical (tests/test_opt_placement.py).
     opt_placement: str = "auto"      # auto | replicated | sharded
+    # --- scatter-resident consensus params (ISSUE 11) ----------------------
+    # param_residency: where the consensus parameter tree LIVES between
+    # rounds — the round-loop twin of per-step ZeRO-3 (parallel/fsdp.py),
+    # built on the ISSUE 9 scatter -> APPLY -> gather decomposition.
+    # "resident" keeps each worker's 1/N bucket shard of the consensus
+    # (the psum_scatter output, post-apply) as the ONLY between-round
+    # parameter state: the trailing all_gather of the sync moves to the
+    # NEXT round's entry, inside the donated round program, so the
+    # gathered full tree is transient compute-scope memory — per-worker
+    # parameter residency and checkpoint payload drop N-fold.
+    # "replicated" is the full-tree-per-worker twin (the pre-ISSUE-11
+    # layout); "auto" = resident whenever the bucketed sharded sync
+    # engine is active AND the between-round params are a shared
+    # consensus at all — weights (FedAvg) aggregation with the "equal"
+    # blend.  Everything else resolves to replicated for the ISSUE 9
+    # reasons: gossip blends and the weighted blend's own-term are
+    # worker-specific by construction, and gradients-mode params are
+    # never synced — worker-local state has no cross-replica-redundant
+    # consensus to shard (docs/ARCHITECTURE.md).  In fp32 (and through
+    # the compressed wire's decode) resident trajectories are BITWISE
+    # identical to the replicated twin: the entry gather moves exactly
+    # the bytes the exit gather used to (tests/test_param_residency.py).
+    param_residency: str = "auto"    # auto | replicated | resident
     # --- runtime sanitizer (ISSUE 6) ---------------------------------------
     # sanitize: arm the round-loop correctness harness — the driver wraps
     # every round dispatch/wait in jax.transfer_guard("disallow") (any
@@ -267,6 +290,8 @@ class Config:
         _choices("sync_compression", self.sync_compression, ("none", "ef"))
         _choices("opt_placement", self.opt_placement,
                  ("auto", "replicated", "sharded"))
+        _choices("param_residency", self.param_residency,
+                 ("auto", "replicated", "resident"))
         if self.grad_accum < 1:
             raise ValueError(
                 f"grad_accum must be >= 1, got {self.grad_accum}")
@@ -293,6 +318,30 @@ class Config:
                 "scale-then-encode apply onto the 1/N shard (the sharded "
                 "placement) — a post-gather replicated apply would gather "
                 "the uncompressed fp32 sum instead")
+        if self.param_residency == "resident" and self.topology != "allreduce":
+            raise ValueError(
+                f"--param_residency resident cannot combine with "
+                f"--topology {self.topology}: gossip blends are "
+                "worker-local by construction — every worker's post-round "
+                "params are a different function of its own value, so "
+                "there is no cross-replica-redundant consensus tree to "
+                "keep scatter-resident (the same argument that resolves "
+                "--opt_placement to 'local' there)")
+        if self.param_residency == "resident" and self.sync_mode == "dense":
+            raise ValueError(
+                "--param_residency resident keeps the psum_scatter "
+                "output as the between-round parameter state — a bucketed-"
+                "sync-engine stage; it cannot combine with "
+                "--sync_mode dense (no scatter whose output could stay "
+                "resident)")
+        if (self.param_residency == "resident"
+                and self.opt_placement == "replicated"):
+            raise ValueError(
+                "--param_residency resident stores the SHARD-side apply "
+                "output (the scaled 1/N scatter shard) as the resident "
+                "state; --opt_placement replicated applies post-gather "
+                "full-size and leaves no per-shard apply output to keep "
+                "resident")
         if self.sync_compression == "ef" and not compressed_wire:
             raise ValueError(
                 "--sync_compression ef compensates compressed-wire "
@@ -385,6 +434,11 @@ class Config:
             # requesting it selects the fast path like a compressed wire
             # does (explicit --sync_mode dense was rejected up front)
             return fast
+        if self.param_residency == "resident":
+            # scatter-resident params ARE a bucketed-engine state layout
+            # (the resident shard is the scatter output); requesting them
+            # selects the fast path the same way (ISSUE 11)
+            return fast
         return fast if backend == "tpu" else "dense"
 
     def resolve_opt_placement(self, backend: str) -> str:
@@ -410,6 +464,50 @@ class Config:
         if self.opt_placement in ("replicated", "sharded"):
             return self.opt_placement
         return "sharded" if mode == "sharded" else "replicated"
+
+    def resolve_param_residency(self, backend: str) -> str:
+        """Resolve ``--param_residency`` into the layout actually run:
+        ``replicated`` | ``resident`` (ISSUE 11).
+
+        ``resident`` — each worker's between-round parameter state is its
+        1/N bucket shard of the consensus tree (the sync's psum_scatter
+        output, post-apply), gathered just-in-time at round entry —
+        requires three things at once:
+
+        1. the bucketed SHARDED sync engine (the scatter whose output
+           stays resident; gossip topologies and the dense per-leaf path
+           have none — explicit resident there is rejected eagerly,
+           ``auto`` resolves to replicated);
+        2. weights (FedAvg) aggregation — in gradients mode the
+           aggregate is discarded and every worker's params evolve
+           independently from round 1 on: worker-local state, nothing
+           cross-replica-redundant to shard (the exact argument that
+           resolves ``--opt_placement`` to "local" on gossip);
+        3. the ``equal`` blend — the weighted blend's output is
+           ``w*own + (1-w)*(total-own)/(n-1)``, a different function of
+           each worker's own full value: the own-term is irreducibly
+           per-worker (the PR 9 ARCHITECTURE.md section documents why),
+           so the whole post-blend tree IS per-worker state and resolves
+           to replicated.
+
+        ``auto`` picks resident exactly when all three hold; an explicit
+        ``resident`` under weighted/gradients resolves to replicated with
+        an engine log line, mirroring ``--opt_placement sharded`` on a
+        gossip topology."""
+        if self.resolve_sync_mode(backend) != "sharded":
+            return "replicated"
+        if self.resolve_opt_placement(backend) != "sharded":
+            # the resident state IS the shard-side apply output; an
+            # explicitly replicated (post-gather) apply leaves none
+            # (explicit resident x replicated is rejected eagerly)
+            return "replicated"
+        if self.aggregation_by != "weights":
+            return "replicated"
+        if self.aggregation_type != "equal":
+            return "replicated"
+        if self.param_residency == "replicated":
+            return "replicated"
+        return "resident"
 
     def parse_prompt_buckets(self) -> tuple[int, ...]:
         """``--serve_prompt_buckets`` as ascending unique lengths."""
@@ -615,6 +713,18 @@ def build_argparser() -> argparse.ArgumentParser:
                         "post-gather full-size twin; auto = sharded when "
                         "the bucketed sync engine is active (gossip "
                         "topologies are worker-local either way)")
+    p.add_argument("--param_residency", type=str, default=d.param_residency,
+                   choices=["auto", "replicated", "resident"],
+                   help="between-round consensus-params layout "
+                        "(round-loop FSDP): resident keeps each worker's "
+                        "1/N bucket shard of the consensus (the sync's "
+                        "scatter output) and all_gathers just-in-time at "
+                        "round entry — per-worker param residency and "
+                        "checkpoint payload drop N-fold; replicated is "
+                        "the full-tree twin; auto = resident whenever the "
+                        "bucketed sharded engine syncs weights with the "
+                        "equal blend (gossip/weighted/gradients states "
+                        "are worker-local and stay replicated)")
     p.add_argument("--serve_max_batch", type=int, default=d.serve_max_batch,
                    help="serve: concurrent decode slots (the one fixed "
                         "shape the decode-step program compiles at)")

@@ -126,7 +126,8 @@ def build_model_for(cfg: Config, num_classes: int, **extra):
 
 
 def checkpoint_metadata(cfg: Config, num_classes: int,
-                        scan_layers: bool) -> dict:
+                        scan_layers: bool,
+                        param_residency: str | None = None) -> dict:
     """The arch facts MANIFEST.json carries so ``serve`` (and future
     inspection tools) rebuild the trained model straight from a checkpoint
     directory instead of the user restating ``--model``/layer flags
@@ -134,7 +135,16 @@ def checkpoint_metadata(cfg: Config, num_classes: int,
     ``serve.engine.model_from_metadata``.  ``opt_placement`` (ISSUE 9)
     records the RESOLVED round-optimizer placement the state was saved
     with — restore re-lays the sharded/replicated moment rows out for the
-    restoring run's placement (``checkpoint.restore_checkpoint``)."""
+    restoring run's placement (``checkpoint.restore_checkpoint``).
+    ``param_residency`` (ISSUE 11) likewise records whether the params
+    were saved as the full replicated tree or as 1/N resident bucket
+    shards, and ``sync_bucket_mb`` the bucket plan the shard layout is
+    keyed to — restore re-lays the params out across residency modes in
+    both directions.  Pass the ENGINE's resolved residency: the engine
+    demotes resident under inner mesh axes / a 1-worker axis, and the
+    manifest must describe the layout actually saved (serve keys its
+    resident-checkpoint rejection off it) — the config resolution is
+    only the mesh-blind fallback."""
     return {"model": cfg.model, "num_classes": int(num_classes),
             "scan_layers": bool(scan_layers),
             "compute_dtype": cfg.compute_dtype,
@@ -143,7 +153,11 @@ def checkpoint_metadata(cfg: Config, num_classes: int,
             "capacity_factor": float(cfg.expert_capacity_factor),
             "dataset": cfg.dataset,
             "opt_placement": cfg.resolve_opt_placement(
-                jax.default_backend())}
+                jax.default_backend()),
+            "param_residency": (param_residency
+                                or cfg.resolve_param_residency(
+                                    jax.default_backend())),
+            "sync_bucket_mb": float(cfg.sync_bucket_mb)}
 
 
 @contextmanager
@@ -635,15 +649,22 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     # ring/double_ring, legacy per-leaf dense otherwise — surfaced here
     # (and as results["sync_engine"]) so a run artifact states which sync
     # program produced it
-    log.info("round-sync engine: %s (topology=%s, wire=%s)",
-             engine.sync_mode, cfg.topology, cfg.sync_dtype)
+    log.info("round-sync engine: %s (topology=%s, wire=%s, "
+             "param_residency=%s)",
+             engine.sync_mode, cfg.topology, cfg.sync_dtype,
+             engine.param_residency)
     sample = trainset.images[:batch]
     if elastic_snapshot is None:
         state = engine.init_state(jax.random.key(cfg.seed), sample)
     else:
         # fresh run from a membership snapshot: the IDENTICAL staging the
         # in-process continuation performs (elastic.py module docstring —
-        # the shared path is what makes the bitwise gate mechanical)
+        # the shared path is what makes the bitwise gate mechanical).
+        # The snapshot carries the per-worker params template a resident
+        # state cannot self-describe (its bucket rows carry no leaf
+        # shapes)
+        if elastic_snapshot.params_template is not None:
+            engine.params_template = elastic_snapshot.params_template
         state = engine.stage_state(elastic_snapshot.host_state)
 
     # --- checkpoint engine + resume (beyond-reference; off when no dir) --
@@ -655,7 +676,9 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         ckpt_engine = ckpt_lib.CheckpointEngine(
             cfg.checkpoint_dir, keep=cfg.ckpt_keep,
             async_write=cfg.ckpt_async,
-            metadata=checkpoint_metadata(cfg, num_classes, layer_scan_on))
+            metadata=checkpoint_metadata(
+                cfg, num_classes, layer_scan_on,
+                param_residency=engine.param_residency))
     start_epoch = 0
     if ckpt_engine is not None and cfg.resume:
         if elastic_snapshot is not None:
@@ -701,12 +724,14 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                     "or kill/join) happened before it was saved; "
                     "restart fresh or resume a pre-change epoch")
         if latest:
-            state, start_epoch = ckpt_lib.restore_checkpoint(latest, state)
+            state, start_epoch = ckpt_lib.restore_checkpoint(
+                latest, state, params_template=engine.params_template,
+                bucket_bytes=engine.sync_bucket_bytes)
             log.info("resumed from %s at global epoch %d", latest, start_epoch)
 
     # --- probe -> ratios -> initial partition ---------------------------
     if elastic_snapshot is None:
-        init_vars = rank0_variables(state)
+        init_vars = engine.rank0_variables(state)
         durations, sec_per_batch = probe_lib.estimate_epoch_duration(
             model, init_vars, sample, n, cfg.probe_batches,
             simulated_durations)
@@ -769,6 +794,10 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         "sync_engine": {
             "mode": engine.sync_mode,
             "opt_placement": engine.opt_placement,
+            # the ENGINE-resolved residency (ISSUE 11): the config
+            # resolution plus the inner-axes / 1-worker demotions — what
+            # the round programs actually ran with
+            "param_residency": engine.param_residency,
             "per_worker_state_bytes": engine.state_resident_bytes(state),
         },
     }
@@ -1095,6 +1124,11 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         mesh = resize_data_axis(mesh, snap.n_workers)
         engine = LocalSGDEngine(model, mesh, cfg, train_model=train_model,
                                 param_specs_fn=param_specs_fn)
+        if snap.params_template is not None:
+            # resident bucket rows carry no leaf shapes; the new engine's
+            # entry gather and host re-layouts need the per-worker
+            # template before any round dispatch
+            engine.params_template = snap.params_template
         state = engine.stage_state(snap.host_state)
         n = snap.n_workers
         worker_ids = list(snap.worker_ids)
@@ -1107,7 +1141,10 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             while len(results["all_workers_losses"]) <= wid:
                 results["all_workers_losses"].append([])
         # the worker count changed, so every per-worker resident-bytes
-        # figure (and the sharded round_opt rows) changed with it
+        # figure (and the sharded round_opt / params_resident rows)
+        # changed with it — as may the residency itself (a quorum of 1
+        # demotes resident to replicated)
+        results["sync_engine"]["param_residency"] = engine.param_residency
         results["sync_engine"]["per_worker_state_bytes"] = \
             engine.state_resident_bytes(state)
 
@@ -1191,7 +1228,8 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             n_round0=n_round0,
             round_opt_placement=(engine.opt_placement
                                  if engine.round_opt_on else None),
-            sync_bucket_bytes=engine.sync_bucket_bytes)
+            sync_bucket_bytes=engine.sync_bucket_bytes,
+            params_template=engine.params_template)
         el["snapshots"].append(elastic_lib.snapshot_copy(snap))
         install_from_snapshot(snap)
         el["events"].extend(change.applied)
@@ -1448,6 +1486,13 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                  el["rounds_degraded"], el["final_worker_ids"])
 
     results["state"] = state
+    # the rank-0 eval variables, residency-agnostic (ISSUE 11): a
+    # scatter-resident final state cannot be sliced by generic consumers
+    # (params is None; the bucket rows carry no leaf shapes), so the
+    # driver — which holds the engine's params template — materializes
+    # the consensus once here; main.py / eval consume this instead of
+    # re-deriving it from the state
+    results["variables"] = engine.rank0_variables(state)
     results["mesh"] = mesh
     results["model"] = model
     results["test"] = test if datasets is None else datasets[2]

@@ -86,6 +86,12 @@ class MembershipSnapshot:
     # original run did — its own starting roster is the post-change one,
     # which would pin (and so perturb) different workers.
     n_round0: int = 0
+    # per-worker params ShapeDtypeStruct tree (ISSUE 11): a scatter-
+    # resident host_state stores params as 1/N bucket rows, which carry
+    # no leaf shapes — the continuing engine's entry gather and host
+    # re-layouts need this template before any round dispatch.  None for
+    # pre-ISSUE-11 snapshots (replicated states self-describe).
+    params_template: Any = None
 
     @property
     def n_workers(self) -> int:
@@ -218,7 +224,8 @@ def host_state_snapshot(state):
 def reshard_state(host_state, kept_positions: list[int],
                   joiner_ids: list[int], *, seed: int,
                   round_opt_placement: str | None = None,
-                  sync_bucket_bytes: int | None = None):
+                  sync_bucket_bytes: int | None = None,
+                  params_template=None):
     """Row-edit a host-numpy worker-stacked ``TrainState`` for a
     membership change.
 
@@ -241,9 +248,53 @@ def reshard_state(host_state, kept_positions: list[int],
     (``comms.round_opt_relayout``): reconstruct the vector, re-pad for
     the new count, re-split.  ``round_opt_placement``/
     ``sync_bucket_bytes`` describe the engine layout; required whenever
-    ``host_state.round_opt`` is present."""
+    ``host_state.round_opt`` is present.
+
+    Scatter-resident params (``host_state.params_resident``, ISSUE 11)
+    follow the same worker-invariant rule: the consensus vector is
+    shared state, never per-worker rows, so a membership change
+    re-tiles it for the new worker count (``comms.resident_relayout`` —
+    pad positions carry exactly-zero values, so re-padding is exact)
+    instead of row-editing; joiners need no params clone because the
+    consensus IS every worker's value.  Requires ``params_template``
+    (per-worker ShapeDtypeStructs — the bucket rows carry no leaf
+    shapes) and ``sync_bucket_bytes``.  A quorum of ONE demotes to the
+    replicated layout (the engine runs resident only on a worker axis
+    >= 2): the consensus tree is materialized and tiled."""
     if not kept_positions:
         raise ValueError("membership change left no surviving workers")
+    resident = host_state.params_resident
+    if resident is not None:
+        if params_template is None or sync_bucket_bytes is None:
+            raise ValueError(
+                "host_state carries scatter-resident params: "
+                "reshard_state needs params_template and "
+                "sync_bucket_bytes to re-tile them")
+        from . import comms
+        n_new = len(kept_positions) + len(joiner_ids)
+        if n_new < 2:
+            # nothing left to shard over: materialize the consensus,
+            # tile it back to the OLD worker rows (identical — it is a
+            # consensus) so the survivor row-take below applies
+            # uniformly, and fall back to the replicated layout a
+            # 1-worker engine runs
+            n_old = next(int(np.shape(a)[0])
+                         for a in jax.tree_util.tree_leaves(resident))
+            full = comms.resident_to_tree(
+                resident, params_template,
+                bucket_bytes=int(sync_bucket_bytes))
+            host_state = host_state.replace(
+                params=jax.tree_util.tree_map(
+                    lambda x: np.broadcast_to(
+                        np.asarray(x)[None],
+                        (n_old, *np.shape(x))).copy(), full),
+                params_resident=None)
+            resident = None
+        else:
+            resident = comms.resident_relayout(
+                resident, params_template, n_new,
+                bucket_bytes=int(sync_bucket_bytes))
+            host_state = host_state.replace(params_resident=None)
     round_opt = host_state.round_opt
     if round_opt is not None:
         if round_opt_placement is None or sync_bucket_bytes is None:
@@ -265,11 +316,11 @@ def reshard_state(host_state, kept_positions: list[int],
     base = jax.tree_util.tree_map(take, host_state)
     k = len(joiner_ids)
     if not k:
-        return base.replace(round_opt=round_opt)
+        return base.replace(round_opt=round_opt, params_resident=resident)
     clone = lambda x: np.concatenate(
         [x, np.repeat(x[:1], k, axis=0)], axis=0)
     out = jax.tree_util.tree_map(clone, base)
-    out = out.replace(round_opt=round_opt)
+    out = out.replace(round_opt=round_opt, params_resident=resident)
     nk = len(kept_positions)
     rng_rows = np.stack([
         np.asarray(jax.random.key_data(
@@ -297,7 +348,8 @@ def build_snapshot(*, epoch: int, change: MembershipChange, old_state,
                    next_worker_id: int = 0,
                    n_round0: int = 0,
                    round_opt_placement: str | None = None,
-                   sync_bucket_bytes: int | None = None
+                   sync_bucket_bytes: int | None = None,
+                   params_template=None
                    ) -> MembershipSnapshot:
     """Assemble the full post-event configuration for round ``epoch``.
 
@@ -332,7 +384,8 @@ def build_snapshot(*, epoch: int, change: MembershipChange, old_state,
         host_state_snapshot(old_state), change.kept_positions,
         change.joiner_ids, seed=seed,
         round_opt_placement=round_opt_placement,
-        sync_bucket_bytes=sync_bucket_bytes)
+        sync_bucket_bytes=sync_bucket_bytes,
+        params_template=params_template)
     _maybe_crash("mid_reshard")
     return MembershipSnapshot(
         epoch=int(epoch), worker_ids=list(change.worker_ids),
@@ -340,7 +393,8 @@ def build_snapshot(*, epoch: int, change: MembershipChange, old_state,
         train_parts=train_parts, val_parts=val_parts,
         fixed_classes=fixed_classes,
         rng_state=copy.deepcopy(rng.bit_generator.state),
-        next_worker_id=int(next_worker_id), n_round0=int(n_round0))
+        next_worker_id=int(next_worker_id), n_round0=int(n_round0),
+        params_template=params_template)
 
 
 def snapshot_copy(snap: MembershipSnapshot) -> MembershipSnapshot:
@@ -354,4 +408,6 @@ def snapshot_copy(snap: MembershipSnapshot) -> MembershipSnapshot:
         val_parts=[p.copy() for p in snap.val_parts],
         fixed_classes=copy.deepcopy(snap.fixed_classes),
         rng_state=copy.deepcopy(snap.rng_state),
-        next_worker_id=snap.next_worker_id, n_round0=snap.n_round0)
+        next_worker_id=snap.next_worker_id, n_round0=snap.n_round0,
+        # ShapeDtypeStructs are immutable — structure sharing is safe
+        params_template=snap.params_template)

@@ -40,10 +40,11 @@ def main(argv=None) -> int:
 
     results = train_global(cfg)
 
-    # rank-0 final test evaluation (ref main.py:61-62)
+    # rank-0 final test evaluation (ref main.py:61-62); the driver
+    # materialized the variables residency-agnostically (a scatter-
+    # resident state carries no sliceable params tree — ISSUE 11)
     if jax.process_index() == 0:
-        from .train import rank0_variables
-        variables = rank0_variables(results["state"])
+        variables = results["variables"]
         test = results["test"]
         evaluate(results["model"], variables, test.images, test.labels,
                  cfg.batch_size, rank=0)

@@ -283,6 +283,17 @@ class ServeEngine:
                     "loads the sharded (format 2) layout — re-save with "
                     "the CheckpointEngine")
         meta = ckpt_lib.manifest_metadata(path)
+        if meta.get("param_residency") == "resident":
+            # ISSUE 11: a scatter-resident checkpoint stores params as
+            # 1/N bucket-shard rows (no .params leaves to stream row 0
+            # of); the training restore path re-lays them out against
+            # its engine template, but serving is template-free
+            raise ValueError(
+                f"checkpoint {path} stores scatter-resident params "
+                "(--param_residency resident); serve needs the "
+                "replicated layout — restore+re-save with "
+                "--param_residency replicated, or point serve at a "
+                "replicated-era epoch")
         if model is None:
             if not meta:
                 raise ValueError(

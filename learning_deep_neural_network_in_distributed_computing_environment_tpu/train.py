@@ -90,6 +90,19 @@ class TrainState(struct.PyTreeNode):
     # vector ([N, padded] — the N-identical-copies baseline).  Layouts
     # interconvert exactly (comms.round_opt_relayout, checkpoint restore).
     round_opt: PyTree = None
+    # Scatter-resident consensus params (ISSUE 11; weights x equal
+    # aggregation under the bucketed sharded engine with
+    # ``--param_residency resident``; None otherwise).  Between rounds
+    # ``params`` is None and this dict — one ``[N, padded/N]`` array per
+    # sync-engine bucket, row w = worker w's contiguous 1/N shard of the
+    # packed consensus vector (exactly the scatter output the sync ends
+    # at) — is the ONLY parameter state: per-worker param residency and
+    # checkpoint payload are 1/N.  The round program all_gathers the
+    # full tree just-in-time at round entry (comms.resident_gather), so
+    # the gathered copy is transient compute-scope memory, never
+    # resident state.  Layouts interconvert exactly
+    # (comms.resident_from_tree / resident_to_tree / resident_relayout).
+    params_resident: PyTree = None
 
 
 def _first_worker_row(x):
@@ -124,11 +137,53 @@ def _first_worker_row(x):
     return jnp.asarray(out)
 
 
-def rank0_variables(state: "TrainState") -> dict:
+def _host_fetch(tree):
+    """Host copy of a device pytree, multi-host-safe: a worker-sharded
+    global array spans non-addressable devices off its own processes,
+    where a plain ``device_get`` raises — ``process_allgather``
+    replicates the value to every host instead (the resident bucket
+    rows are small: 1/N of the params per worker)."""
+    if jax.process_count() == 1:
+        return jax.device_get(tree)
+    from jax.experimental import multihost_utils
+    return multihost_utils.process_allgather(tree, tiled=True)
+
+
+def resident_consensus(state: "TrainState", params_template,
+                       bucket_bytes: int | None = None) -> PyTree:
+    """HOST per-worker consensus params of a scatter-resident state —
+    the host twin of the round-entry gather (concatenating the shard
+    rows is bit-exact data movement).  THE one reconstruction path:
+    ``rank0_variables`` and ``LocalSGDEngine.materialize_params`` both
+    route through it."""
+    if params_template is None:
+        raise ValueError(
+            "state carries scatter-resident params (params_resident): "
+            "pass params_template/bucket_bytes or use "
+            "LocalSGDEngine.rank0_variables / materialize_params")
+    return comms.resident_to_tree(
+        _host_fetch(state.params_resident), params_template,
+        bucket_bytes=bucket_bytes or comms.DEFAULT_BUCKET_BYTES)
+
+
+def rank0_variables(state: "TrainState", *, params_template=None,
+                    bucket_bytes: int | None = None) -> dict:
     """Worker-0 slice of a stacked TrainState as model.apply variables —
-    the reference's rank-0 model for test evaluation (main.py:61-62)."""
-    variables = {"params": jax.tree_util.tree_map(_first_worker_row,
-                                                  state.params)}
+    the reference's rank-0 model for test evaluation (main.py:61-62).
+
+    A scatter-resident state (ISSUE 11: ``params`` is None,
+    ``params_resident`` holds the 1/N bucket shards) needs
+    ``params_template`` (per-worker ShapeDtypeStructs) and the engine's
+    ``bucket_bytes`` to reconstruct the consensus on host — the host
+    twin of the round-entry gather, bit-exact (``engine.rank0_variables``
+    passes them for you)."""
+    if state.params is None:
+        # the consensus IS every worker's value — no row slice needed
+        variables = {"params": resident_consensus(
+            state, params_template, bucket_bytes)}
+    else:
+        variables = {"params": jax.tree_util.tree_map(_first_worker_row,
+                                                      state.params)}
     if jax.tree_util.tree_leaves(state.batch_stats):
         variables["batch_stats"] = jax.tree_util.tree_map(
             _first_worker_row, state.batch_stats)
@@ -450,6 +505,46 @@ class LocalSGDEngine:
                 "opt_placement sharded requested on a %s topology: gossip "
                 "blends are worker-local (no global reduce), resolved to "
                 "'local' — see docs/ARCHITECTURE.md", cfg.topology)
+        # --- scatter-resident consensus params (ISSUE 11) ---------------
+        # Where the consensus parameter tree lives BETWEEN rounds:
+        # "resident" keeps each worker's 1/N bucket shard (the sync's
+        # scatter output) and the round program gathers just-in-time at
+        # entry; "replicated" keeps the full tree per worker.  The
+        # config resolution requires the sharded engine + weights x
+        # equal aggregation (everything else is worker-local state —
+        # docs/ARCHITECTURE.md); the engine additionally demotes under
+        # inner mesh axes (TP/PP/EP/FSDP/SP shard the param leaves
+        # themselves, which would make the bucket plan per-device —
+        # the round_opt precedent) and on a 1-worker axis (nothing to
+        # shard).  fp32 resident rounds are bitwise-identical to the
+        # replicated twin (tests/test_param_residency.py).
+        self.param_residency = cfg.resolve_param_residency(
+            jax.default_backend())
+        if (self.param_residency == "resident"
+                and (self._inner_axes or self.n_workers < 2)):
+            self.param_residency = "replicated"
+            if cfg.param_residency == "resident":
+                log.info(
+                    "param_residency resident requested but %s: the "
+                    "bucket plan must stay per-worker — resolved to "
+                    "'replicated'",
+                    "inner mesh axes shard the param leaves"
+                    if self._inner_axes else "the worker axis is 1")
+        elif (cfg.param_residency == "resident"
+                and self.param_residency == "replicated"):
+            log.info(
+                "param_residency resident requested under %s/%s "
+                "aggregation: the between-round params are worker-local "
+                "state (the weighted own-term / unsynced gradients-mode "
+                "params are per-worker by construction), resolved to "
+                "'replicated' — see docs/ARCHITECTURE.md",
+                cfg.aggregation_by, cfg.aggregation_type)
+        self.resident_on = self.param_residency == "resident"
+        # per-worker params template (ShapeDtypeStructs, no worker
+        # axis): set by init_state / stage_state, or installed from a
+        # MembershipSnapshot — the resident layout's bucket plan, entry
+        # gather, and host re-layouts all derive from it
+        self.params_template = None
         # Packed-path sync placement: on XLA:CPU the sync stays FUSED in
         # the round program — dispatching a second collective program
         # while the round is in flight risks the 1-core rendezvous
@@ -482,18 +577,29 @@ class LocalSGDEngine:
     def _sync_body(self, params, grads, residual, round_opt=None):
         """The once-per-round sync point, per worker (inside shard_map).
 
-        Returns ``(params', residual', round_opt', agg_grad_norm)``.
-        Weights mode replaces params with the aggregate (FedAvg);
-        gradients mode runs the collectives on the stale last-batch
+        Returns ``(params', resident', residual', round_opt',
+        agg_grad_norm)``.  Weights mode replaces params with the
+        aggregate (FedAvg) — under the resident layout (ISSUE 11) the
+        program ENDS at the scatter instead: ``params'`` is None and
+        ``resident'`` carries the post-apply 1/N bucket shards, the
+        between-round state the next round's entry gather consumes.
+        Gradients mode runs the collectives on the stale last-batch
         grads and reports only their norm (reference semantics,
         SURVEY.md 3.2) — plus, when the round-optimizer tracker is armed
         (ISSUE 9), the shard-resident Adam moment update of the
         aggregated mean gradient."""
         cfg = self.cfg
         agg_grad_norm = jnp.zeros(())
+        resident = None
         fast = self.sync_mode in ("sharded", "gossip")
         if cfg.aggregation_by == "weights":
-            if fast:
+            if self.resident_on:
+                resident, residual, _ = comms.sharded_opt_sync(
+                    params,
+                    **self._fast_kwargs(residual if self.sync_ef
+                                        else None))
+                params = None
+            elif fast:
                 params, residual = self._fast_sync(
                     params, residual if self.sync_ef else None)
             else:
@@ -511,7 +617,7 @@ class LocalSGDEngine:
                     grads, how=cfg.aggregation_type,
                     topology=cfg.topology, local_weight=cfg.local_weight)
             agg_grad_norm = self._grad_global_norm(agg)
-        return params, residual, round_opt, agg_grad_norm
+        return params, resident, residual, round_opt, agg_grad_norm
 
     def _fast_kwargs(self, residual=None) -> dict:
         """Shared kwargs of the bucketed sharded engine calls, including
@@ -525,7 +631,8 @@ class LocalSGDEngine:
                     local_weight=cfg.local_weight,
                     wire_dtype=self.sync_wire_dtype, residual=residual,
                     bucket_bytes=self.sync_bucket_bytes,
-                    opt_placement=placement)
+                    opt_placement=placement,
+                    residency=self.param_residency)
 
     def _fast_sync(self, tree, residual):
         """Run the resolved bucketed fast engine on one pytree:
@@ -534,8 +641,10 @@ class LocalSGDEngine:
         ``(out, new_residual)`` contract."""
         if self.sync_mode == "gossip":
             kw = self._fast_kwargs(residual)
-            # gossip has no apply stage to place (worker-local blends)
+            # gossip has no apply stage to place and no scatter whose
+            # output could stay resident (worker-local blends)
             kw.pop("opt_placement")
+            kw.pop("residency")
             return comms.gossip_sync(tree, topology=self.cfg.topology,
                                      **kw)
         return comms.sharded_sync(tree, **self._fast_kwargs(residual))
@@ -550,9 +659,15 @@ class LocalSGDEngine:
         measurement does not apply), so downstream viz/bench can key on
         the fields unconditionally."""
         if self._sync_bytes is None:
-            shapes = jax.tree_util.tree_map(
-                lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype),
-                params_stacked)
+            # the per-worker template is authoritative once set (the
+            # resident layout's stacked params are bucket rows, not
+            # leaf shapes); the stacked fallback serves template-less
+            # replicated callers
+            shapes = self.params_template
+            if shapes is None:
+                shapes = jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype),
+                    params_stacked)
             wire = (self.sync_wire_dtype
                     if self.sync_mode in ("sharded", "gossip")
                     else jnp.float32)
@@ -572,7 +687,16 @@ class LocalSGDEngine:
         sharded over ``data``, so a worker's share of a leaf is
         ``nbytes / N`` — for the sharded round-optimizer layout that is
         1/N of the tracked vector, for the replicated layout the whole
-        vector (N identical copies across the axis)."""
+        vector (N identical copies across the axis).
+
+        ISSUE 11 split: under the resident params layout ``params``
+        counts the 1/N bucket-shard rows (the only between-round
+        parameter state) and ``params_gathered_peak`` the TRANSIENT
+        padded full buffers the round-entry gather materializes in
+        compute scope — exactly N x the resident shard, the measured
+        form of the N-fold residency drop.  Replicated layouts report
+        the full tree under ``params`` and a zero peak (no transient
+        copy exists beyond the resident one)."""
         def per_worker(tree) -> int:
             total = 0
             for leaf in jax.tree_util.tree_leaves(tree):
@@ -582,10 +706,43 @@ class LocalSGDEngine:
                     else 1
                 total += size * itemsize // rows
             return total
-        return {"params": per_worker(state.params),
+        gathered_peak = 0
+        if state.params is None and state.params_resident is not None:
+            # the gather's transient buffers are the PADDED bucket
+            # vectors — each resident leaf [N, padded/N] regathers to
+            # [padded], i.e. the leaf's own nbytes
+            gathered_peak = sum(
+                int(np.prod(np.shape(leaf), dtype=np.int64))
+                * np.dtype(leaf.dtype).itemsize
+                for leaf in jax.tree_util.tree_leaves(
+                    state.params_resident))
+        return {"params": (per_worker(state.params)
+                           + per_worker(state.params_resident)),
+                "params_gathered_peak": gathered_peak,
                 "opt_state": per_worker(state.opt_state),
                 "ef_residual": per_worker(state.sync_residual),
                 "round_opt": per_worker(state.round_opt)}
+
+    def materialize_params(self, state: TrainState) -> PyTree:
+        """HOST per-worker consensus params of a possibly
+        scatter-resident state (ISSUE 11): the host twin of the
+        round-entry gather — ``resident_consensus`` with the engine's
+        template/bucket context, so consumers (final eval, inspection)
+        see exactly the tree the round program would have gathered.
+        Replicated states return their worker-0 row (every row is the
+        consensus after an equal-blend sync; the general per-worker
+        case keeps using ``state.params`` directly)."""
+        if state.params is not None:
+            return jax.tree_util.tree_map(_first_worker_row, state.params)
+        return resident_consensus(state, self.params_template,
+                                  self.sync_bucket_bytes)
+
+    def rank0_variables(self, state: TrainState) -> dict:
+        """``train.rank0_variables`` with the engine's residency context
+        threaded through — works on replicated AND scatter-resident
+        states (the driver's probe / final-eval surface)."""
+        return rank0_variables(state, params_template=self.params_template,
+                               bucket_bytes=self.sync_bucket_bytes)
 
     # ------------------------------------------------------------------
     # Multi-host data movement
@@ -653,6 +810,8 @@ class LocalSGDEngine:
         # one-shot per engine: init runs exactly once per train_global
         # graftlint: disable=R2 -- single Xavier-init trace, not a loop
         params, batch_stats, opt_state = jax.jit(_init)(rng)
+        self.params_template = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params)
         if self.param_specs_fn is not None and self.param_specs is None:
             # derive TP/PP/EP specs from the per-worker template while it
             # is in hand: stage_state's lazy fallback would otherwise pull
@@ -663,8 +822,19 @@ class LocalSGDEngine:
             return jax.tree_util.tree_map(
                 lambda x: jnp.broadcast_to(x[None], (n, *x.shape)), tree)
 
+        # resident residency (ISSUE 11): the broadcast init IS a
+        # consensus (identical on every worker), so the between-round
+        # layout starts scatter-resident from round 0 — every round
+        # program then has the one shape (resident in, resident out) and
+        # the sanitizer's zero-retrace budget holds from the warmup on
+        resident = (comms.resident_from_tree(
+            jax.device_get(params), n,
+            bucket_bytes=self.sync_bucket_bytes)
+            if self.resident_on else None)
         state = TrainState(
-            params=tile(params), batch_stats=tile(batch_stats),
+            params=None if self.resident_on else tile(params),
+            params_resident=resident,
+            batch_stats=tile(batch_stats),
             opt_state=tile(opt_state),
             lr_epoch=jnp.zeros((n,), jnp.int32),
             rng=jax.vmap(lambda i: jax.random.key_data(
@@ -691,6 +861,19 @@ class LocalSGDEngine:
         cross-mesh reshard.  Under TP/PP/EP the param specs are derived
         lazily from the state's own (squeezed) parameter structure, so a
         snapshot-restored engine never needs an ``init_state`` call."""
+        if (self.resident_on and state.params_resident is None) or (
+                not self.resident_on and state.params_resident is not None):
+            raise ValueError(
+                f"stage_state: state params residency does not match the "
+                f"engine's ({self.param_residency!r}) — re-lay the host "
+                "state out first (comms.resident_from_tree / "
+                "resident_to_tree, or checkpoint.restore_checkpoint's "
+                "cross-residency path)")
+        if self.params_template is None and state.params is not None:
+            self.params_template = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(tuple(np.shape(x)[1:]),
+                                               np.dtype(x.dtype)),
+                state.params)
         if self.param_specs_fn is not None:
             if self.param_specs is None:
                 p0 = jax.tree_util.tree_map(
@@ -1165,7 +1348,20 @@ class LocalSGDEngine:
 
         def per_worker(state: TrainState, x, y, m, xv, yv, mv):
             """One worker's round.  x:[S,B,...] y,m:[S,B]; val likewise."""
-            zero_grads = _zeros_like_varying(state.params)
+            if self.resident_on:
+                # ISSUE 11 round-entry gather: the between-round state is
+                # the 1/N bucket shard of the consensus; the full tree is
+                # reconstructed HERE, inside the donated round program, so
+                # the gathered copy is transient compute-scope memory —
+                # bit-for-bit the tree the replicated twin carried (the
+                # gather moves the exact bytes the sync-exit gather used
+                # to)
+                params0 = comms.resident_gather(
+                    state.params_resident, self.params_template,
+                    bucket_bytes=self.sync_bucket_bytes)
+            else:
+                params0 = state.params
+            zero_grads = _zeros_like_varying(params0)
 
             def local_epoch(carry, _):
                 params, batch_stats, opt_state, lr_epoch, rng, _ = carry
@@ -1202,7 +1398,7 @@ class LocalSGDEngine:
                 return ((params, batch_stats, opt_state, lr_epoch, rng,
                          last_grads), per_epoch)
 
-            carry0 = (state.params, state.batch_stats, state.opt_state,
+            carry0 = (params0, state.batch_stats, state.opt_state,
                       state.lr_epoch, state.rng, zero_grads)
             (params, batch_stats, opt_state, lr_epoch, rng, last_grads), \
                 per_epoch = lax.scan(local_epoch, carry0, None,
@@ -1218,8 +1414,9 @@ class LocalSGDEngine:
             agg_grad_norm = jnp.zeros(())
             residual = state.sync_residual
             round_opt = state.round_opt
+            resident = None
             if not self.split_sync:
-                params, residual, round_opt, agg_grad_norm = \
+                params, resident, residual, round_opt, agg_grad_norm = \
                     self._sync_body(params, last_grads, residual,
                                     round_opt)
 
@@ -1236,7 +1433,8 @@ class LocalSGDEngine:
                 global_val_acc=lax.pmean(
                     per_epoch["val_acc"].mean(), DATA_AXIS),
             )
-            new_state = TrainState(params=params, batch_stats=batch_stats,
+            new_state = TrainState(params=params, params_resident=resident,
+                                   batch_stats=batch_stats,
                                    opt_state=opt_state, lr_epoch=lr_epoch,
                                    rng=rng, sync_residual=residual,
                                    round_opt=round_opt)
@@ -1345,13 +1543,21 @@ class LocalSGDEngine:
             sync = self._round_cache["sync"]
             if self.cfg.aggregation_by == "weights":
                 if self.sync_ef:
-                    params, residual, fence = sync(new_state.params,
+                    synced, residual, fence = sync(new_state.params,
                                                    new_state.sync_residual)
                 else:
-                    params, fence = sync(new_state.params)
+                    synced, fence = sync(new_state.params)
                     residual = new_state.sync_residual
-                new_state = new_state.replace(params=params,
-                                              sync_residual=residual)
+                if self.resident_on:
+                    # the sync ended at the scatter: the resident bucket
+                    # shards replace the (donated) full params as the
+                    # between-round state
+                    new_state = new_state.replace(
+                        params=None, params_resident=synced,
+                        sync_residual=residual)
+                else:
+                    new_state = new_state.replace(params=synced,
+                                                  sync_residual=residual)
             else:
                 if self.round_opt_on:
                     sync_norm, new_tracker = sync(outs[1],
@@ -1523,16 +1729,40 @@ class LocalSGDEngine:
 
         pspec = self._sspec.params if self._sspec is not None else self._spec
         if cfg.aggregation_by == "weights":
+            if self.resident_on:
+                # ISSUE 11: the standalone sync ENDS at the scatter — it
+                # consumes (donates) the round's full post-training
+                # params and returns the post-apply 1/N bucket shards,
+                # the only parameter state alive between rounds
+                if self.sync_ef:
+                    def per_worker(params, residual):
+                        _p, res, r, _t, _ = self._sync_body(params, None,
+                                                            residual)
+                        return res, r, _fence(res)
+                    return self._wrap_stacked(
+                        per_worker, [pspec, pspec],
+                        out_specs=(self._spec, pspec, self._spec),
+                        donate=(0, 1))
+
+                def per_worker(params):
+                    _p, res, _r, _t, _ = self._sync_body(params, None,
+                                                         None)
+                    return res, _fence(res)
+                return self._wrap_stacked(per_worker, [pspec],
+                                          out_specs=(self._spec,
+                                                     self._spec),
+                                          donate=(0,))
             if self.sync_ef:
                 def per_worker(params, residual):
-                    p, r, _t, _ = self._sync_body(params, None, residual)
+                    p, _res, r, _t, _ = self._sync_body(params, None,
+                                                        residual)
                     return p, r, _fence(p)
                 return self._wrap_stacked(
                     per_worker, [pspec, pspec],
                     out_specs=(pspec, pspec, self._spec), donate=(0, 1))
 
             def per_worker(params):
-                p, _r, _t, _ = self._sync_body(params, None, None)
+                p, _res, _r, _t, _ = self._sync_body(params, None, None)
                 return p, _fence(p)
             return self._wrap_stacked(per_worker, [pspec],
                                       out_specs=(pspec, self._spec),
@@ -1544,15 +1774,15 @@ class LocalSGDEngine:
             # rows alongside the grads — shard-resident moments update in
             # place between the scatter and the norm's gather
             def per_worker(grads, round_opt):
-                _p, _r, trk, norm = self._sync_body(None, grads, None,
-                                                    round_opt)
+                _p, _res, _r, trk, norm = self._sync_body(None, grads,
+                                                          None, round_opt)
                 return norm, trk
             return self._wrap_stacked(per_worker, [pspec, self._spec],
                                       out_specs=(self._spec, self._spec),
                                       donate=(0, 1))
 
         def per_worker(grads):
-            _p, _r, _t, norm = self._sync_body(None, grads, None)
+            _p, _res, _r, _t, norm = self._sync_body(None, grads, None)
             return norm
         return self._wrap_stacked(per_worker, [pspec],
                                   out_specs=self._spec, donate=(0,))
@@ -1597,15 +1827,28 @@ class LocalSGDEngine:
         # unconstrained program hands back UNSHARDED leaves, which the
         # chunk program then silently reshards device-to-device every
         # round (the sanitizer's transfer guard caught exactly that).
+        params0 = state.params
+        if self.resident_on:
+            # ISSUE 11: the streamed chunk programs consume full params,
+            # so a cached donated ENTER program re-gathers them from the
+            # resident bucket shards at round start — the full tree then
+            # lives only for the duration of the round (the standalone
+            # sync at round end re-scatters it and the chunk programs'
+            # donation frees the working copy)
+            if "enter" not in self._round_cache:
+                self._round_cache["enter"] = comms.make_resident_gather(
+                    self.mesh, self.params_template,
+                    bucket_bytes=self.sync_bucket_bytes, donate=True)
+            params0 = self._round_cache["enter"](state.params_resident)
         if "zeros" not in self._round_cache:
             self._round_cache["zeros"] = jax.jit(
                 lambda p: jax.tree_util.tree_map(jnp.zeros_like, p),
                 out_shardings=jax.tree_util.tree_map(
-                    lambda x: x.sharding, state.params))
+                    lambda x: x.sharding, params0))
         zeros_like = self._round_cache["zeros"]
 
-        inner = (state.params, state.batch_stats, state.opt_state, state.rng,
-                 zeros_like(state.params))
+        inner = (params0, state.batch_stats, state.opt_state, state.rng,
+                 zeros_like(params0))
         epoch0 = int(jax.device_get(_first_worker_row(state.lr_epoch)))
 
         per_epoch = []  # (train_chunk_ys, val_chunk_sums) device arrays
@@ -1665,11 +1908,18 @@ class LocalSGDEngine:
         self._arm_sync_stats(params)
         residual = state.sync_residual
         round_opt = state.round_opt
+        resident = None
         if cfg.aggregation_by == "weights":
             if self.sync_ef:
-                params, residual, fence = sync(params, residual)
+                synced, residual, fence = sync(params, residual)
             else:
-                params, fence = sync(params)
+                synced, fence = sync(params)
+            if self.resident_on:
+                # the sync ended at the scatter: only the bucket shards
+                # survive the round (the donated full params are gone)
+                resident, params = synced, None
+            else:
+                params = synced
             # weights mode reports a zero norm; keep it a sharded device
             # array so the multi-host metric fetch (process_allgather)
             # sees the same global [N] layout as the gradients mode
@@ -1695,7 +1945,8 @@ class LocalSGDEngine:
             self._round_cache["bump_epoch"] = jax.jit(
                 lambda e: e + jnp.asarray(cfg.epochs_local, e.dtype))
         new_state = TrainState(
-            params=params, batch_stats=batch_stats, opt_state=opt_state,
+            params=params, params_resident=resident,
+            batch_stats=batch_stats, opt_state=opt_state,
             lr_epoch=self._round_cache["bump_epoch"](state.lr_epoch),
             rng=rng, sync_residual=residual, round_opt=round_opt)
         return new_state, ("streamed", per_epoch, agg_grad_norm)

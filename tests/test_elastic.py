@@ -297,8 +297,14 @@ class TestReshardState:
     def _host_state(self, mesh4):
         from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import get_model
         from learning_deep_neural_network_in_distributed_computing_environment_tpu.train import LocalSGDEngine
+        # param_residency pinned replicated: these cases gate the PER-
+        # WORKER row edit (survivor np.take, joiner clone, zero EF rows);
+        # the compressed-weights config would otherwise auto-resolve the
+        # ISSUE 11 resident layout, whose consensus params are re-TILED
+        # instead of row-edited (tests/test_param_residency.py owns that)
         cfg = Config(model="mlp", batch_size=8, sync_compression="ef",
-                     sync_dtype="bfloat16", aggregation_by="weights")
+                     sync_dtype="bfloat16", aggregation_by="weights",
+                     param_residency="replicated")
         eng = LocalSGDEngine(get_model("mlp", num_classes=10, hidden=8),
                              mesh4, cfg)
         state = eng.init_state(jax.random.key(0), np.zeros((8, 28, 28, 1),

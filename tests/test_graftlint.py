@@ -347,6 +347,36 @@ def sync_round(sync, grads, round_opt):
 """
         assert "R4" not in rules_for(src)
 
+    def test_enter_gather_resident_use_after_donate_flagged(self):
+        # ISSUE 11 fixture: the round-entry gather program DONATES the
+        # resident bucket shards into the gather (train.py streamed
+        # "enter" cache / comms.make_resident_gather donate=True);
+        # reading the donated resident input after the call would touch
+        # freed 1/N shard buffers — the exact hazard class R4 exists for
+        src = """
+import jax
+def enter_round(gather, resident):
+    prog = jax.jit(gather, donate_argnums=(0,))
+    params = prog(resident)
+    shard_bytes = resident  # donated resident shards read after the call
+    return params, shard_bytes
+"""
+        assert "R4" in rules_for(src)
+
+    def test_enter_gather_resident_rebound_clean(self):
+        # the engine's real shape: the resident name is rebound to the
+        # NEXT sync's scatter output before any further read — the
+        # steady-state resident cycle (gather consumes, scatter renews)
+        src = """
+import jax
+def enter_round(gather, sync, resident):
+    prog = jax.jit(gather, donate_argnums=(0,))
+    params = prog(resident)
+    resident = sync(params)
+    return resident
+"""
+        assert "R4" not in rules_for(src)
+
     def test_rebound_name_no_longer_shard_map_clean(self):
         src = """
 import jax

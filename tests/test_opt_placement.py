@@ -243,17 +243,26 @@ class TestEngineOptPlacement:
         return engine, state, mx
 
     def test_weights_round_bitwise_across_placements(self, mesh8):
+        # param_residency pinned replicated: this case gates the ISSUE 9
+        # apply PLACEMENT on the full params tree (the sharded-placement
+        # run would otherwise auto-resolve the ISSUE 11 resident layout,
+        # whose params leaves are empty — tests/test_param_residency.py
+        # owns that axis)
         states = {}
         for pl in ("replicated", "sharded"):
             eng, st, _ = self._round(
                 mesh8, small_cfg(sync_mode="sharded",
-                                 sync_bucket_mb=0.001, opt_placement=pl))
+                                 sync_bucket_mb=0.001, opt_placement=pl,
+                                 param_residency="replicated"))
             assert eng.opt_placement == pl
             assert st.round_opt is None    # weights mode: no boundary
             states[pl] = st                # moments exist to track
-        for a, b in zip(
-                jax.tree_util.tree_leaves(states["replicated"].params),
-                jax.tree_util.tree_leaves(states["sharded"].params)):
+        leaves = {
+            pl: jax.tree_util.tree_leaves(states[pl].params)
+            for pl in states}
+        assert leaves["replicated"] and (
+            len(leaves["replicated"]) == len(leaves["sharded"]))
+        for a, b in zip(leaves["replicated"], leaves["sharded"]):
             assert np.array_equal(np.asarray(a), np.asarray(b))
 
     def test_gradients_tracker_layouts_and_norm_bitwise(self, mesh8):

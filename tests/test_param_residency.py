@@ -1,0 +1,569 @@
+"""Scatter-resident consensus params (ISSUE 11 tentpole): round-loop FSDP.
+
+The between-round parameter state under ``--param_residency resident`` is
+each worker's 1/N bucket shard of the consensus (exactly the sync's
+psum_scatter output, post-apply); the round program all_gathers the full
+tree just-in-time at entry and the sync ends at the scatter — the
+trailing all_gather moved from sync-exit to next-round-entry, so it moves
+the SAME bytes and the trajectories are BITWISE identical to the
+replicated twin:
+
+- comms level: resident cycle (sync -> stay scattered -> entry gather)
+  vs the replicated program, 2/4/8 workers, fp32 and the compressed
+  wire's decoded handoff;
+- engine level: whole rounds (fused CPU sync and the standalone/streamed
+  sync program), equal active + weighted/gradients resolution;
+- driver level (slow): sanitized e2e incl. an elastic kill+join and a
+  checkpoint save/restore.
+
+Resolution: resident requires the bucketed sharded engine + weights x
+equal aggregation — the weighted blend's own-term and gradients-mode
+params are irreducibly per-worker (the PR 9 ARCHITECTURE.md argument),
+gossip has no scatter at all.  Checkpoints save the resident shards
+directly (no gather on the write path) and re-layout across residency
+modes on restore; elastic membership changes re-tile the shards.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from learning_deep_neural_network_in_distributed_computing_environment_tpu import (
+    comms,
+    elastic as elastic_lib,
+    mesh as mesh_lib,
+)
+from learning_deep_neural_network_in_distributed_computing_environment_tpu import checkpoint as ckpt_lib
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import get_model
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.train import (
+    LocalSGDEngine,
+    TrainState,
+    rank0_variables,
+)
+
+N = 8
+SHAPES = {"a": (13, 7), "b": (257,), "c": (31, 5), "d": (3,)}
+TINY_BUCKET = 1024
+
+
+def stacked_tree(n=N, seed=0):
+    rng = np.random.default_rng(seed)
+    return {k: jnp.asarray(rng.normal(size=(n, *s)), jnp.float32)
+            for k, s in SHAPES.items()}
+
+
+def per_worker_shapes():
+    return {k: jax.ShapeDtypeStruct(s, jnp.float32)
+            for k, s in SHAPES.items()}
+
+
+def sub_mesh(k):
+    return mesh_lib.build_mesh({"data": k}, devices=jax.devices()[:k])
+
+
+def small_cfg(**kw):
+    base = dict(model="mlp", dataset="mnist", epochs_local=2,
+                epochs_global=2, batch_size=8, compute_dtype="float32",
+                augment=False, aggregation_by="weights",
+                sync_mode="sharded", sync_bucket_mb=0.001)
+    base.update(kw)
+    return Config(**base)
+
+
+def make_engine(mesh, cfg):
+    return LocalSGDEngine(get_model("mlp", num_classes=10, hidden=16),
+                          mesh, cfg)
+
+
+def make_packs(n=8, steps=4, b=8, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, steps, b, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, (n, steps, b)).astype(np.int32)
+    m = np.ones((n, steps, b), np.float32)
+    return x, y, m
+
+
+def assert_trees_equal(a, b):
+    jax.tree_util.tree_map(
+        lambda x, y: np.testing.assert_array_equal(np.asarray(x),
+                                                   np.asarray(y)), a, b)
+
+
+class TestResidencyResolution:
+    def test_auto_follows_the_engine_and_aggregation(self):
+        # resident needs the bucketed sharded engine AND a consensus to
+        # shard (weights x equal); CPU fp32 auto resolves the dense twin
+        assert small_cfg().resolve_param_residency("cpu") == "resident"
+        assert small_cfg(
+            sync_mode="auto",
+            sync_bucket_mb=4.0).resolve_param_residency("cpu") == "replicated"
+        assert small_cfg(
+            sync_mode="auto",
+            sync_bucket_mb=4.0).resolve_param_residency("tpu") == "resident"
+        assert small_cfg(
+            sync_dtype="bfloat16", sync_compression="ef", sync_mode="auto",
+        ).resolve_param_residency("cpu") == "resident"
+
+    def test_worker_local_states_resolve_replicated(self):
+        # the weighted own-term and gradients-mode params are
+        # irreducibly per-worker — the PR 9 documented argument
+        for kw in (dict(aggregation_type="weighted"),
+                   dict(aggregation_by="gradients")):
+            cfg = small_cfg(param_residency="resident", **kw)
+            assert cfg.resolve_param_residency("cpu") == "replicated", kw
+
+    def test_explicit_resident_selects_the_fast_engine(self):
+        cfg = small_cfg(sync_mode="auto", param_residency="resident",
+                        sync_bucket_mb=4.0)
+        assert cfg.resolve_sync_mode("cpu") == "sharded"
+        assert cfg.resolve_param_residency("cpu") == "resident"
+
+    def test_replicated_placement_resolves_residency_replicated(self):
+        cfg = small_cfg(opt_placement="replicated")
+        assert cfg.resolve_param_residency("cpu") == "replicated"
+
+    @pytest.mark.parametrize("kw,match", [
+        (dict(topology="ring"), "topology"),
+        (dict(topology="double_ring"), "topology"),
+        (dict(sync_mode="dense"), "dense"),
+        (dict(opt_placement="replicated"), "replicated"),
+    ])
+    def test_eager_rejections(self, kw, match):
+        with pytest.raises(ValueError, match=match):
+            base = dict(param_residency="resident")
+            base.update(kw)
+            if "sync_mode" in kw or "opt_placement" in kw:
+                small_cfg(**base)
+            else:
+                Config(**base)
+
+    def test_engine_demotes_under_inner_axes(self):
+        mesh = mesh_lib.build_mesh({"data": 4, "model": 2})
+        eng = LocalSGDEngine(
+            get_model("bert_tiny", num_classes=8, scan_layers=True),
+            mesh, small_cfg(model="bert_tiny",
+                            param_residency="resident",
+                            mesh_shape="data=4,model=2"),
+            param_specs_fn=lambda p: __import__(
+                "learning_deep_neural_network_in_distributed_computing_environment_tpu.models.bert",
+                fromlist=["tp_param_specs"]).tp_param_specs(p, axis="model"))
+        assert eng.param_residency == "replicated"
+
+    def test_comms_rejects_resident_without_equal_sharded(self, mesh8):
+        tree = stacked_tree()
+        with pytest.raises(Exception, match="equal blend"):
+            comms.make_host_sync(
+                mesh8, mode="sharded", how="weighted",
+                param_residency="resident")(tree)
+        with pytest.raises(ValueError, match="scatter"):
+            comms.make_host_sync(mesh8, mode="gossip", topology="ring",
+                                 param_residency="resident")
+
+    def test_comms_rejects_single_worker_resident(self):
+        mesh1 = sub_mesh(1)
+        with pytest.raises(Exception, match="worker axis"):
+            comms.make_host_sync(
+                mesh1, mode="sharded",
+                param_residency="resident")(stacked_tree(n=1))
+
+
+class TestCommsResidentCycle:
+    @pytest.mark.parametrize("k", [2, 4, 8])
+    def test_fp32_cycle_bitwise_equals_replicated(self, k):
+        """The acceptance gate, comms level: sync -> stay scattered ->
+        entry gather reproduces the replicated program's output
+        bit-for-bit (the gather moves the same bytes, one round later)."""
+        mesh = sub_mesh(k)
+        tree = stacked_tree(n=k)
+        rep = comms.make_host_sync(mesh, mode="sharded",
+                                   bucket_bytes=TINY_BUCKET)(tree)[0]
+        res, _r = comms.make_host_sync(
+            mesh, mode="sharded", bucket_bytes=TINY_BUCKET,
+            param_residency="resident")(tree)
+        for leaf in jax.tree_util.tree_leaves(res):
+            assert leaf.shape[0] == k          # [n, padded/n] bucket rows
+        gat = comms.make_resident_gather(mesh, per_worker_shapes(),
+                                         bucket_bytes=TINY_BUCKET)(res)
+        assert_trees_equal(gat, rep)
+
+    def test_compressed_wire_handoff_bitwise(self, mesh8):
+        # the resident shard stores the DECODED mean (own scale applied),
+        # so the entry gather concatenates exactly what gather_decoded
+        # would have produced — bitwise even on the int8 wire
+        tree = stacked_tree()
+        res0 = {k: jnp.zeros((N, *s), jnp.float32)
+                for k, s in SHAPES.items()}
+        for wdt in (jnp.bfloat16, jnp.int8):
+            rep = comms.make_host_sync(
+                mesh8, mode="sharded", wire_dtype=wdt,
+                bucket_bytes=TINY_BUCKET)(tree, res0)[0]
+            res, _r = comms.make_host_sync(
+                mesh8, mode="sharded", wire_dtype=wdt,
+                bucket_bytes=TINY_BUCKET,
+                param_residency="resident")(tree, res0)
+            gat = comms.make_resident_gather(
+                mesh8, per_worker_shapes(), bucket_bytes=TINY_BUCKET)(res)
+            assert_trees_equal(gat, rep)
+
+    def test_host_twins_roundtrip_bitwise(self, mesh8):
+        # resident_to_tree is the host twin of the device gather and
+        # resident_from_tree its exact inverse
+        tree = stacked_tree()
+        res, _ = comms.make_host_sync(
+            mesh8, mode="sharded", bucket_bytes=TINY_BUCKET,
+            param_residency="resident")(tree)
+        host = jax.device_get(res)
+        rep = comms.make_host_sync(mesh8, mode="sharded",
+                                   bucket_bytes=TINY_BUCKET)(tree)[0]
+        consensus = comms.resident_to_tree(host, per_worker_shapes(),
+                                           bucket_bytes=TINY_BUCKET)
+        for k in SHAPES:
+            np.testing.assert_array_equal(np.asarray(rep[k][0]),
+                                          consensus[k])
+        back = comms.resident_from_tree(consensus, N,
+                                        bucket_bytes=TINY_BUCKET)
+        for b in host:
+            np.testing.assert_array_equal(host[b], back[b])
+
+    def test_relayout_retiles_exactly(self, mesh8):
+        tree = stacked_tree()
+        res, _ = comms.make_host_sync(
+            mesh8, mode="sharded", bucket_bytes=TINY_BUCKET,
+            param_residency="resident")(tree)
+        host = jax.device_get(res)
+        down = comms.resident_relayout(host, per_worker_shapes(), 3,
+                                       bucket_bytes=TINY_BUCKET)
+        back = comms.resident_relayout(down, per_worker_shapes(), N,
+                                       bucket_bytes=TINY_BUCKET)
+        for b in host:
+            np.testing.assert_array_equal(np.asarray(host[b]), back[b])
+        with pytest.raises(ValueError, match="bucket"):
+            comms.resident_relayout({}, per_worker_shapes(), 4,
+                                    bucket_bytes=TINY_BUCKET)
+
+
+class TestEngineResidency:
+    def _run(self, mesh, cfg, rounds=2):
+        engine = make_engine(mesh, cfg)
+        n = mesh.shape["data"]
+        x, y, m = make_packs(n=n)
+        state = engine.init_state(jax.random.key(0), x[0, 0])
+        mx = None
+        for _ in range(rounds):
+            state, mx = engine.round(state, (x, y, m), (x, y, m))
+        return engine, state, mx
+
+    @pytest.mark.parametrize("k", [2, 8])
+    def test_rounds_bitwise_across_residencies(self, k):
+        outs = {}
+        for pr in ("replicated", "resident"):
+            eng, st, mx = self._run(sub_mesh(k),
+                                    small_cfg(param_residency=pr))
+            assert eng.param_residency == pr
+            outs[pr] = (eng, st, mx)
+        eng_r, st_r, mx_r = outs["resident"]
+        assert st_r.params is None and st_r.params_resident is not None
+        assert_trees_equal(eng_r.materialize_params(st_r),
+                           outs["replicated"][0].materialize_params(
+                               outs["replicated"][1]))
+        for key in ("train_loss", "val_loss", "global_train_loss",
+                    "global_val_loss"):
+            np.testing.assert_array_equal(np.asarray(mx_r[key]),
+                                          np.asarray(outs["replicated"][2][key]))
+
+    @pytest.mark.parametrize("how,by", [("weighted", "weights"),
+                                        ("equal", "gradients")])
+    def test_worker_local_modes_demote_and_match(self, mesh8, how, by):
+        # the resolution cells where resident degrades to replicated:
+        # the programs must be IDENTICAL, not merely close
+        outs = {}
+        for pr in ("replicated", "resident"):
+            eng, st, mx = self._run(
+                mesh8, small_cfg(param_residency=pr, aggregation_type=how,
+                                 aggregation_by=by), rounds=1)
+            assert eng.param_residency == "replicated"
+            assert st.params is not None and st.params_resident is None
+            outs[pr] = (st, mx)
+        assert_trees_equal(outs["resident"][0].params,
+                           outs["replicated"][0].params)
+        for key in ("train_loss", "val_loss"):
+            np.testing.assert_array_equal(
+                np.asarray(outs["resident"][1][key]),
+                np.asarray(outs["replicated"][1][key]))
+
+    def test_streamed_round_uses_enter_program_and_matches(self, mesh8):
+        # the streamed path runs the standalone donated sync program
+        # (resident exit) plus the donated enter-gather program
+        outs = {}
+        for pr in ("replicated", "resident"):
+            engine = make_engine(mesh8, small_cfg(param_residency=pr,
+                                                  epochs_local=1))
+            x, y, m = make_packs()
+            state = engine.init_state(jax.random.key(0), x[0, 0])
+            chunks = lambda e: iter([(x[:, :2], y[:, :2], m[:, :2]),
+                                     (x[:, 2:], y[:, 2:], m[:, 2:])])
+            for _ in range(2):
+                state, mx = engine.round_streamed(state, chunks, chunks)
+            outs[pr] = (engine, state, mx)
+        eng_r, st_r, mx_r = outs["resident"]
+        assert "enter" in eng_r._round_cache
+        assert st_r.params is None
+        assert_trees_equal(eng_r.materialize_params(st_r),
+                           outs["replicated"][0].materialize_params(
+                               outs["replicated"][1]))
+        np.testing.assert_array_equal(
+            np.asarray(mx_r["train_loss"]),
+            np.asarray(outs["replicated"][2]["train_loss"]))
+
+    def test_resident_state_bytes_exactly_one_nth(self, mesh8):
+        eng, st, _ = self._run(mesh8, small_cfg(param_residency="resident"),
+                               rounds=1)
+        b = eng.state_resident_bytes(st)
+        # the transient gathered peak is the padded full buffers — the
+        # resident shard is EXACTLY 1/N of it
+        assert b["params"] > 0
+        assert b["params"] * N == b["params_gathered_peak"]
+
+    def test_rank0_variables_needs_template(self, mesh8):
+        eng, st, _ = self._run(mesh8, small_cfg(param_residency="resident"),
+                               rounds=1)
+        with pytest.raises(ValueError, match="params_template"):
+            rank0_variables(st)
+        v = eng.rank0_variables(st)
+        assert set(v["params"])   # non-empty params tree
+
+
+class TestCheckpointCrossResidency:
+    def _engine_state(self, mesh, pr):
+        engine = make_engine(mesh, small_cfg(param_residency=pr))
+        x, y, m = make_packs(n=mesh.shape["data"])
+        state = engine.init_state(jax.random.key(0), x[0, 0])
+        state, _ = engine.round(state, (x, y, m), (x, y, m))
+        return engine, state
+
+    def test_resident_save_has_no_full_params_and_roundtrips(self, mesh8,
+                                                             tmp_path):
+        eng_s, st_s = self._engine_state(mesh8, "resident")
+        eng_r, tmpl_r = self._engine_state(mesh8, "replicated")
+        ckpt_lib.save_checkpoint(str(tmp_path / "s"), st_s, 1)
+        latest = ckpt_lib.latest_checkpoint(str(tmp_path / "s"))
+        tree, ep = ckpt_lib.host_tree(latest)
+        assert ep == 1
+        # the save path serialized the 1/N shards directly — no full
+        # params leaf was ever materialized or written
+        assert any(k.startswith(".params_resident") for k in tree)
+        assert not any(k.startswith(".params[") for k in tree)
+        # resident save -> replicated restore
+        got_r, _ = ckpt_lib.restore_checkpoint(
+            latest, tmpl_r, params_template=eng_r.params_template,
+            bucket_bytes=eng_r.sync_bucket_bytes)
+        assert got_r.params is not None and got_r.params_resident is None
+        assert_trees_equal(
+            jax.tree_util.tree_map(lambda x: np.asarray(x)[0],
+                                   jax.device_get(got_r.params)),
+            eng_s.materialize_params(st_s))
+        # replicated save -> resident restore, closing the loop bitwise
+        ckpt_lib.save_checkpoint(str(tmp_path / "r"), got_r, 2)
+        got_s, ep2 = ckpt_lib.restore_checkpoint(
+            ckpt_lib.latest_checkpoint(str(tmp_path / "r")), st_s,
+            params_template=eng_s.params_template,
+            bucket_bytes=eng_s.sync_bucket_bytes)
+        assert ep2 == 2
+        for b, rows in jax.device_get(st_s.params_resident).items():
+            np.testing.assert_array_equal(
+                rows, np.asarray(jax.device_get(got_s.params_resident)[b]))
+
+    def test_pre_issue11_checkpoint_restores_into_resident(self, mesh8,
+                                                           tmp_path):
+        # a replicated-era checkpoint (post-sync consensus rows) restores
+        # into a resident run unchanged
+        eng_p, st_p = self._engine_state(mesh8, "replicated")
+        eng_s, tmpl_s = self._engine_state(mesh8, "resident")
+        ckpt_lib.save_checkpoint(str(tmp_path / "p"), st_p, 3)
+        got, ep = ckpt_lib.restore_checkpoint(
+            ckpt_lib.latest_checkpoint(str(tmp_path / "p")), tmpl_s,
+            params_template=eng_s.params_template,
+            bucket_bytes=eng_s.sync_bucket_bytes)
+        assert ep == 3 and got.params is None
+        assert_trees_equal(eng_s.materialize_params(got),
+                           eng_p.materialize_params(st_p))
+
+    def test_non_consensus_rows_refused(self, mesh8, tmp_path):
+        # a gradients-mode state's params rows differ per worker; packing
+        # row 0 silently would lose information — must refuse
+        eng_g, st_g = self._engine_state(mesh8, "replicated")
+        host = jax.device_get(st_g)
+        bad = host.replace(params=jax.tree_util.tree_map(
+            lambda x: np.asarray(x)
+            + np.arange(x.shape[0], dtype=np.float32).reshape(
+                (-1,) + (1,) * (np.ndim(x) - 1)), host.params))
+        bad = jax.tree_util.tree_map(np.asarray, bad)
+        ckpt_lib.save_checkpoint(str(tmp_path / "b"), bad, 4)
+        eng_s, tmpl_s = self._engine_state(mesh8, "resident")
+        with pytest.raises(ValueError, match="consensus"):
+            ckpt_lib.restore_checkpoint(
+                ckpt_lib.latest_checkpoint(str(tmp_path / "b")), tmpl_s,
+                params_template=eng_s.params_template,
+                bucket_bytes=eng_s.sync_bucket_bytes)
+
+
+class TestElasticResidentRelayout:
+    def _host_state(self, n=4):
+        pw = per_worker_shapes()
+        rng = np.random.default_rng(3)
+        consensus = {k: rng.normal(size=s).astype(np.float32)
+                     for k, s in SHAPES.items()}
+        resident = comms.resident_from_tree(consensus, n,
+                                            bucket_bytes=TINY_BUCKET)
+        opt = {k: np.zeros((n, *s), np.float32) for k, s in SHAPES.items()}
+        return consensus, TrainState(
+            params=None, params_resident=resident, batch_stats={},
+            opt_state={"mu": opt},
+            lr_epoch=np.zeros((n,), np.int32),
+            rng=np.zeros((n, 2), np.uint32)), pw
+
+    def test_kill_join_retiles_the_consensus(self):
+        consensus, host, pw = self._host_state()
+        out = elastic_lib.reshard_state(
+            host, kept_positions=[0, 2, 3], joiner_ids=[4], seed=0,
+            sync_bucket_bytes=TINY_BUCKET, params_template=pw)
+        # same n: the consensus vector is preserved exactly (kill+join
+        # is a swap; joiners need no params clone — the consensus IS
+        # every worker's value)
+        got = comms.resident_to_tree(out.params_resident, pw,
+                                     bucket_bytes=TINY_BUCKET)
+        assert_trees_equal(got, consensus)
+        # per-worker rows still row-edited
+        assert out.lr_epoch.shape == (4,)
+
+    def test_shrink_retiles_and_quorum_of_one_demotes(self):
+        consensus, host, pw = self._host_state()
+        down = elastic_lib.reshard_state(
+            host, kept_positions=[0, 1, 2], joiner_ids=[], seed=0,
+            sync_bucket_bytes=TINY_BUCKET, params_template=pw)
+        assert down.params is None
+        got = comms.resident_to_tree(down.params_resident, pw,
+                                     bucket_bytes=TINY_BUCKET)
+        assert_trees_equal(got, consensus)
+        solo = elastic_lib.reshard_state(
+            host, kept_positions=[2], joiner_ids=[], seed=0,
+            sync_bucket_bytes=TINY_BUCKET, params_template=pw)
+        # a 1-worker engine runs replicated: materialized and tiled
+        assert solo.params_resident is None
+        assert_trees_equal(
+            jax.tree_util.tree_map(lambda x: x[0], solo.params), consensus)
+
+    def test_missing_layout_kwargs_raise(self):
+        _c, host, _pw = self._host_state()
+        with pytest.raises(ValueError, match="params_template"):
+            elastic_lib.reshard_state(host, kept_positions=[0, 1],
+                                      joiner_ids=[], seed=0)
+
+
+# ----------------------------------------------------------------------
+# Driver e2e composition (slow: each case is multiple train_global runs)
+# ----------------------------------------------------------------------
+
+def _e2e_cfg(**kw):
+    base = dict(model="mlp", dataset="mnist", epochs_global=4,
+                epochs_local=1, batch_size=16, limit_train_samples=400,
+                limit_eval_samples=100, compute_dtype="float32",
+                augment=False, seed=1, num_workers=4,
+                aggregation_by="weights", sync_mode="sharded",
+                sync_bucket_mb=0.001)
+    base.update(kw)
+    return Config(**base)
+
+
+PROBE4 = np.array([1.0, 1.5, 1.0, 2.0])
+
+# pinned round walls: the repartition EMA consumes measured wall times,
+# so an A/B of two runs must feed both the same vector or the shards
+# (and with them the trajectories) drift apart from round 2 on
+WALLS4 = lambda e: np.ones(4)
+
+TAIL_KEYS = ("global_train_losses", "global_val_losses",
+             "global_train_accuracies", "global_val_accuracies",
+             "step_caps", "shard_sizes")
+
+
+@pytest.mark.slow
+class TestDriverResidency:
+    """The acceptance gate at the sanitized-driver level: fp32 resident
+    trajectories bitwise-match the replicated twin across the
+    equal/weighted x weights/gradients matrix, including through an
+    elastic kill+join and a checkpoint save/restore."""
+
+    @pytest.mark.parametrize("how,by", [("equal", "weights"),
+                                        ("weighted", "weights"),
+                                        ("equal", "gradients")])
+    def test_sanitized_trajectories_bitwise(self, how, by):
+        runs = {}
+        for pr in ("replicated", "resident"):
+            res = train_global(
+                _e2e_cfg(param_residency=pr, aggregation_type=how,
+                         aggregation_by=by, sanitize=True),
+                progress=False, simulated_durations=PROBE4,
+                simulated_round_durations=WALLS4)
+            assert res["sanitize"]["retrace_count"] == 0
+            assert res["sanitize"]["transfer_guard_violations"] == 0
+            runs[pr] = res
+        # equal x weights actually runs resident; the worker-local cells
+        # resolve to replicated — either way the trajectories must match
+        expect = ("resident" if (how, by) == ("equal", "weights")
+                  else "replicated")
+        assert runs["resident"]["sync_engine"]["param_residency"] == expect
+        for k in TAIL_KEYS:
+            assert runs["resident"][k] == runs["replicated"][k], k
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                       np.asarray(b)),
+            runs["resident"]["variables"], runs["replicated"]["variables"])
+
+    def test_kill_join_keeps_the_bitwise_gate(self):
+        kw = dict(chaos="kill@2:w1,join@2", sanitize=True)
+        runs = {}
+        for pr in ("replicated", "resident"):
+            runs[pr] = train_global(
+                _e2e_cfg(param_residency=pr, **kw), progress=False,
+                simulated_durations=PROBE4,
+                simulated_round_durations=WALLS4)
+            assert len(runs[pr]["elastic"]["events"]) == 2
+            assert runs[pr]["sanitize"]["retrace_count"] == 0
+        for k in TAIL_KEYS:
+            assert runs["resident"][k] == runs["replicated"][k], k
+        # and the resident run's own fresh twin from the snapshot
+        snap = runs["resident"]["elastic"]["snapshots"][0]
+        assert snap.host_state.params_resident is not None
+        assert snap.params_template is not None
+        fresh = train_global(
+            _e2e_cfg(param_residency="resident", **kw), progress=False,
+            simulated_durations=PROBE4, simulated_round_durations=WALLS4,
+            elastic_snapshot=snap)
+        for k in TAIL_KEYS:
+            assert runs["resident"][k][2:] == fresh[k], k
+
+    def test_checkpoint_save_restore_through_the_driver(self, tmp_path):
+        runs = {}
+        for pr in ("replicated", "resident"):
+            d = str(tmp_path / pr)
+            first = train_global(
+                _e2e_cfg(param_residency=pr, epochs_global=2,
+                         checkpoint_dir=d, checkpoint_every=1),
+                progress=False, simulated_durations=PROBE4,
+                simulated_round_durations=WALLS4)
+            resumed = train_global(
+                _e2e_cfg(param_residency=pr, epochs_global=4,
+                         checkpoint_dir=d, checkpoint_every=1,
+                         resume=True),
+                progress=False, simulated_durations=PROBE4,
+                simulated_round_durations=WALLS4)
+            assert len(resumed["global_train_losses"]) == 2
+            runs[pr] = (first, resumed)
+            assert ckpt_lib.manifest_metadata(d)["param_residency"] == pr
+        for k in TAIL_KEYS:
+            assert runs["resident"][0][k] == runs["replicated"][0][k], k
+            assert runs["resident"][1][k] == runs["replicated"][1][k], k

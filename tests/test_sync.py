@@ -289,8 +289,15 @@ class TestDriverTelemetry:
         se = res["sync_engine"]
         assert se["mode"] == "sharded"
         assert se["opt_placement"] == "sharded"   # auto follows the engine
+        # ISSUE 11: weights x equal under the sharded engine auto-resolves
+        # the scatter-resident params layout, and the state-bytes split
+        # records it — the resident shard is EXACTLY 1/N of the transient
+        # gathered peak (the padded full buffers the round-entry gather
+        # materializes in compute scope)
+        assert se["param_residency"] == "resident"
         pw = se["per_worker_state_bytes"]
         assert pw["params"] > 0 and pw["opt_state"] > 0
+        assert pw["params"] * 8 == pw["params_gathered_peak"]
         assert pw["ef_residual"] == 0 and pw["round_opt"] == 0
         assert res["compile_cache"]["enabled"] is False
         import os
@@ -312,6 +319,12 @@ class TestDriverTelemetry:
         for t in res["round_timings"]:
             assert t["sync_bytes"] > 0
             assert t["sync_ms"] >= 0.0  # the standalone sync program ran
+        # the streamed path rides the resident layout too (enter program
+        # + scatter-exit standalone sync); a replicated layout would
+        # report a zero transient gather peak instead
+        pw = res["sync_engine"]["per_worker_state_bytes"]
+        assert res["sync_engine"]["param_residency"] == "resident"
+        assert pw["params"] * 8 == pw["params_gathered_peak"]
 
 
 class TestBenchEntry:

@@ -38,7 +38,15 @@ echo "== tier-1 tests (ROADMAP.md) =="
 set -o pipefail
 rm -f /tmp/_t1.log
 t1_start=$SECONDS
-timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+# JAX_GRAFT_TEST_COMPILE_CACHE (ISSUE 11 satellite; the ROADMAP's named
+# tier-1 wall lever): arm the session-persistent XLA compile cache so
+# repeated verify runs on one host stop re-paying the round-program
+# compiles that dominate the suite.  CI tiers gating on numerics want
+# this; compile-TIMING work must run with it explicitly empty
+# (JAX_GRAFT_TEST_COMPILE_CACHE= tools/verify.sh).
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+  JAX_GRAFT_TEST_COMPILE_CACHE="${JAX_GRAFT_TEST_COMPILE_CACHE-.jax_cache/tests}" \
+  python -m pytest tests/ -q -m 'not slow' \
   --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly \
   2>&1 | tee /tmp/_t1.log
 rc=${PIPESTATUS[0]}
@@ -65,6 +73,42 @@ if [ "${SKIP_BENCH:-0}" != "1" ]; then
   if [ "$brc" -ne 0 ]; then
     echo "bench smoke FAILED (rc=$brc)"
     exit "$brc"
+  fi
+
+  # seconds-scale sharded-sync smoke (ISSUE 11 satellite): the --entry
+  # sync dispatch on a 2-worker virtual CPU mesh, asserting the fp32
+  # sharded path stayed bit-identical to dense AND the new
+  # param-residency axis: per-worker resident param bytes at exactly 1/N
+  # of the transient gathered peak, the resident cycle (scatter-exit +
+  # entry gather) bitwise equal to the replicated program, and the
+  # checkpoint write path gather-free (the resident layout's params
+  # payload per worker IS the 1/N shard).
+  echo "== bench smoke: sharded sync entry (CPU, 2 workers) =="
+  SYNC_JSON=$(XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    JAX_PLATFORMS=cpu BENCH_BUDGET_S="${BENCH_BUDGET_S:-240}" \
+    python bench.py --entry sync) || { echo "sync smoke FAILED"; exit 1; }
+  echo "$SYNC_JSON"
+  python - "$SYNC_JSON" <<'EOF'
+import json, sys
+out = json.loads(sys.argv[1])
+if out.get("status") == "budget_backstop":
+    sys.exit(0)  # slow host: the backstop line is the accepted outcome
+assert out["bitwise_sharded_eq_dense"] is True
+pr = out["param_residency"]
+assert pr["bitwise_resident_eq_replicated"] is True
+assert pr["resident_vs_gathered_peak_bytes"] == pr["expected_resident_ratio"]
+assert pr["ckpt_gather_free_save"] is True
+n = out["n_workers"]
+assert abs(pr["resident"]["ckpt_params_mb_per_worker"] * n
+           - pr["resident"]["params_mb_per_worker"] * n) < 1e-9
+assert pr["resident"]["params_mb_per_worker"] \
+    < pr["replicated"]["params_mb_per_worker"]
+print("sync smoke OK")
+EOF
+  syrc=$?
+  if [ "$syrc" -ne 0 ]; then
+    echo "sync smoke assertions FAILED (rc=$syrc)"
+    exit "$syrc"
   fi
 
   # seconds-scale gossip-engine smoke (ISSUE 4 satellite): the --entry
@@ -412,14 +456,21 @@ kw = dict(model="mlp", dataset="mnist", epochs_global=2, epochs_local=1,
           sync_mode="sharded", sanitize=True)
 runs = {}
 for pl in ("replicated", "sharded"):
+    # param_residency pinned replicated: this smoke gates the ISSUE 9
+    # apply PLACEMENT on the full params tree (the sharded run would
+    # otherwise auto-resolve the ISSUE 11 resident layout, whose state
+    # carries no params leaves — the residency smoke below owns that axis)
     res = train_global(Config(aggregation_by="weights", opt_placement=pl,
+                              param_residency="replicated",
                               **kw), progress=False)
     assert res["sync_engine"]["opt_placement"] == pl, res["sync_engine"]
     assert res["sanitize"]["retrace_count"] == 0
     assert res["sanitize"]["transfer_guard_violations"] == 0
     runs[pl] = jax.device_get(res["state"].params)
-for a, b in zip(jax.tree_util.tree_leaves(runs["replicated"]),
-                jax.tree_util.tree_leaves(runs["sharded"])):
+leaves = {pl: jax.tree_util.tree_leaves(runs[pl]) for pl in runs}
+assert leaves["replicated"] and \
+    len(leaves["replicated"]) == len(leaves["sharded"])
+for a, b in zip(leaves["replicated"], leaves["sharded"]):
     assert np.array_equal(np.asarray(a), np.asarray(b)), \
         "sharded apply diverged from the replicated twin"
 byt = {}
@@ -436,6 +487,57 @@ EOF
 rc=$?
 if [ "$rc" -ne 0 ]; then
   echo "opt-placement smoke FAILED (rc=$rc)"
+  exit "$rc"
+fi
+
+# Param-residency smoke (ISSUE 11): the SAME sanitized weights-mode
+# config under --param_residency replicated vs resident — between rounds
+# the resident run holds only each worker's 1/N bucket shard of the
+# consensus (entry gather inside the donated round program, sync ends at
+# the scatter), and the trajectories plus final consensus params must be
+# BITWISE identical through the real driver with ZERO post-warmup
+# retraces.  Also asserts the recorded state-bytes split: resident shard
+# exactly 1/N of the transient gathered peak.
+echo "== param-residency smoke (2-worker resident vs replicated, sanitized) =="
+XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+  JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+import jax
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+
+kw = dict(model="mlp", dataset="mnist", epochs_global=2, epochs_local=1,
+          batch_size=16, limit_train_samples=256, limit_eval_samples=64,
+          compute_dtype="float32", augment=False, seed=7, num_workers=2,
+          aggregation_by="weights", sync_mode="sharded", sanitize=True)
+runs = {}
+for pr in ("replicated", "resident"):
+    res = train_global(Config(param_residency=pr, **kw), progress=False)
+    assert res["sync_engine"]["param_residency"] == pr, res["sync_engine"]
+    assert res["sanitize"]["retrace_count"] == 0
+    assert res["sanitize"]["transfer_guard_violations"] == 0
+    runs[pr] = res
+assert runs["resident"]["state"].params is None
+assert runs["resident"]["state"].params_resident is not None
+for k in ("global_train_losses", "global_val_losses"):
+    assert runs["resident"][k] == runs["replicated"][k], k
+a = jax.tree_util.tree_leaves(runs["resident"]["variables"]["params"])
+b = jax.tree_util.tree_leaves(runs["replicated"]["variables"]["params"])
+assert a and len(a) == len(b)
+for x, y in zip(a, b):
+    assert np.array_equal(np.asarray(x), np.asarray(y)), \
+        "resident consensus diverged from the replicated twin"
+pw = runs["resident"]["sync_engine"]["per_worker_state_bytes"]
+assert pw["params"] * 2 == pw["params_gathered_peak"], pw
+pww = runs["replicated"]["sync_engine"]["per_worker_state_bytes"]
+assert pww["params_gathered_peak"] == 0
+print("param-residency smoke OK: resident rounds bitwise == replicated,"
+      f" per-worker resident params {pw['params']} vs transient peak"
+      f" {pw['params_gathered_peak']} (1/2)")
+EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "param-residency smoke FAILED (rc=$rc)"
   exit "$rc"
 fi
 
