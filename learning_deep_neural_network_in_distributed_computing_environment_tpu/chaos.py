@@ -23,7 +23,25 @@ exercised and gated in ordinary pytest runs:
                        that pushes the wall past ``time_limit`` plus the
                        retry/backoff-extended grace makes the straggler
                        policy declare the worker DEPARTED (an implicit
-                       kill at the next boundary).
+                       kill at the next boundary);
+- ``crash@R:wI``     — worker I vanishes MID-ROUND, non-cooperatively
+                       (ISSUE 12): its measured wall for round R is
+                       non-finite — the simulated form of a missed
+                       round-fence deadline — and the straggler policy
+                       returns the distinct verdict CRASHED (no retry
+                       ladder: a missed fence means the worker is gone,
+                       not slow).  The driver voids the round, rolls
+                       back to the last completed round boundary in
+                       memory, reconstructs the lost resident shard
+                       spans from the worker's ring buddy (or the
+                       newest committed checkpoint on a double fault),
+                       and re-runs the round on the surviving quorum;
+- ``nan@R:wI``       — worker I's round-R sync contribution is poisoned
+                       with NaN (ISSUE 12): the sync engines' integrity
+                       screen quarantines the contribution for the
+                       round (the blend renormalizes over the finite
+                       survivors) and the driver escalates repeated
+                       strikes to a departure after ``--chaos_retries``.
 
 Events are pure data keyed by ABSOLUTE round index, so a checkpoint
 resume (or a fresh run started from a membership snapshot) replays the
@@ -41,7 +59,13 @@ import re
 
 import numpy as np
 
-KINDS = ("kill", "join", "slow", "stall")
+KINDS = ("kill", "join", "slow", "stall", "crash", "nan")
+
+# kinds `--chaos random` draws from by default: the PR 8 cooperative /
+# timing faults.  The unplanned-failure kinds (crash/nan) are opt-in via
+# --chaos_kinds — a random schedule must never silently start exercising
+# the rollback-recovery machinery under a config that predates it.
+DEFAULT_RANDOM_KINDS = ("kill", "join", "slow", "stall")
 
 # kind@round[:wID][xFACTOR][+SECONDS][*ROUNDS]
 _EVENT_RE = re.compile(
@@ -115,7 +139,8 @@ def parse_chaos_spec(spec: str) -> list[ChaosEvent]:
                 "membership is --num_workers; membership and wall faults "
                 "are round-boundary events)")
         worker = m.group("worker")
-        if kind in ("kill", "slow", "stall") and worker is None:
+        if kind in ("kill", "slow", "stall", "crash", "nan") \
+                and worker is None:
             raise ValueError(
                 f"chaos event {part!r}: {kind} needs a :w<ID> target")
         # reject inapplicable suffixes too — 'join@3:w5' (joiners take
@@ -150,19 +175,30 @@ def parse_chaos_spec(spec: str) -> list[ChaosEvent]:
     return sorted(events, key=lambda e: (e.round, e.kind))
 
 
-def random_events(seed: int, count: int, epochs_global: int
+def random_events(seed: int, count: int, epochs_global: int,
+                  kinds: tuple[str, ...] = DEFAULT_RANDOM_KINDS
                   ) -> list[ChaosEvent]:
     """``--chaos random``: ``count`` seeded-random events drawn up front
     (never lazily — the whole schedule must be reconstructable from the
     seed alone for checkpoint-resume replay).  Kills carry a
     ``worker_frac`` resolved against the membership list at apply time;
-    slow/stall target fractions the same way."""
+    slow/stall (and the ISSUE 12 crash/nan kinds, when selected via
+    ``--chaos_kinds``) target fractions pinned to round-0 logical ids by
+    ``pin_wall_targets``."""
     if epochs_global < 2:
         return []
+    kinds = tuple(kinds)
+    for k in kinds:
+        if k not in KINDS:
+            raise ValueError(
+                f"unknown chaos kind {k!r} in the random-mode selection: "
+                f"expected a subset of {KINDS}")
+    if not kinds:
+        raise ValueError("--chaos random needs at least one event kind")
     rng = np.random.default_rng(seed)
     out: list[ChaosEvent] = []
     for _ in range(max(0, int(count))):
-        kind = KINDS[int(rng.integers(0, len(KINDS)))]
+        kind = kinds[int(rng.integers(0, len(kinds)))]
         rnd = int(rng.integers(1, epochs_global))
         frac = float(rng.random())
         out.append(ChaosEvent(
@@ -191,8 +227,11 @@ class ChaosSchedule:
         if not cfg.chaos:
             return None
         if cfg.chaos.strip().lower() == "random":
+            kinds = (cfg.parse_chaos_kinds()
+                     if hasattr(cfg, "parse_chaos_kinds")
+                     else DEFAULT_RANDOM_KINDS)
             sched = cls(random_events(cfg.chaos_seed, cfg.chaos_events,
-                                      cfg.epochs_global))
+                                      cfg.epochs_global, kinds=kinds))
             if cfg.num_workers:
                 sched.pin_wall_targets(range(cfg.num_workers))
             # num_workers == 0 (mesh-derived): the driver pins against
@@ -200,22 +239,46 @@ class ChaosSchedule:
             return sched
         return cls(parse_chaos_spec(cfg.chaos))
 
+    # random-mode kinds whose target pins at round 0: wall perturbations
+    # (slow/stall) and the unplanned faults (crash/nan) — a crash whose
+    # target silently migrated after a membership change would diverge
+    # the fresh-twin's recovery from the continued run's.  Kills stay
+    # frac-resolved at apply time (a kill must land on a live worker).
+    PINNED_KINDS = ("slow", "stall", "crash", "nan")
+
     def pin_wall_targets(self, roster) -> None:
-        """Pin random-mode slow/stall targets to concrete LOGICAL ids
-        against the round-0 ``roster``, once.  Resolving the frac per
-        query would silently migrate a persistent fault to a different
-        worker after a membership change (and diverge a fresh-twin run,
-        whose starting roster is the post-change one).  Kills stay
-        frac-resolved at apply time — a kill must land on a live worker.
+        """Pin random-mode slow/stall/crash/nan targets to concrete
+        LOGICAL ids against the round-0 ``roster``, once.  Resolving the
+        frac per query would silently migrate a persistent fault to a
+        different worker after a membership change (and diverge a
+        fresh-twin run, whose starting roster is the post-change one).
         Idempotent: already-pinned events are untouched."""
         roster = list(roster)
         if not roster:
             return
         self.events = [dataclasses.replace(
                            e, worker=self._resolve(e, roster))
-                       if e.kind in ("slow", "stall")
+                       if e.kind in self.PINNED_KINDS
                        and e.worker is None else e
                        for e in self.events]
+
+    def has_kind(self, kind: str) -> bool:
+        """Whether the schedule contains any event of ``kind`` — the
+        driver arms the crash-rollback snapshot pool and the NaN
+        integrity screen exactly when the schedule can exercise them."""
+        return any(e.kind == kind for e in self.events)
+
+    def nan_targets(self, rnd: int, worker_ids: list[int]) -> list[int]:
+        """Logical ids whose round-``rnd`` sync contribution is poisoned
+        (``nan@R:wI`` — single-round faults, resolved against the
+        current membership)."""
+        out: list[int] = []
+        for e in self.events:
+            if e.kind == "nan" and e.round == rnd:
+                w = self._resolve(e, worker_ids)
+                if w in worker_ids:
+                    out.append(int(w))
+        return out
 
     def membership_events(self, rnd: int) -> list[ChaosEvent]:
         """kill/join events taking effect at the boundary entering
@@ -239,6 +302,17 @@ class ChaosSchedule:
                 w = self._resolve(e, worker_ids)
                 if w in worker_ids:
                     out[worker_ids.index(w)] += e.seconds
+            elif e.kind == "crash" and e.round == rnd:
+                # the worker vanished mid-round: it never reports a wall
+                # at all — a MISSED round-fence deadline, simulated as a
+                # non-finite wall (the straggler policy's distinct
+                # "crashed" verdict keys off finiteness, not magnitude).
+                # After the rollback recovery the worker is out of the
+                # membership, so the re-run of this round (and every
+                # later round) resolves no target here.
+                w = self._resolve(e, worker_ids)
+                if w in worker_ids:
+                    out[worker_ids.index(w)] = np.inf
         return out
 
     @staticmethod
@@ -284,14 +358,25 @@ class StragglerPolicy:
         return self.time_limit + self.grace * (1.0 + self.backoff * k)
 
     def observe(self, worker_ids: list[int], walls: np.ndarray
-                ) -> tuple[list[int], list[dict]]:
+                ) -> tuple[list[int], list[int], list[dict]]:
         """Feed one round's per-worker walls; returns
-        ``(departed_ids, retry_records)``.  ``retry_records`` are the
-        tolerated overruns (for ``results["elastic"]["sync_retries"]``
-        accounting and logs)."""
+        ``(departed_ids, crashed_ids, retry_records)``.
+
+        A NON-FINITE wall is the distinct CRASHED verdict (ISSUE 12):
+        the worker missed the round fence entirely — it is gone, not
+        slow, so no retry/backoff ladder applies and its attempt state
+        is dropped.  Finite overruns keep the PR 8 ladder: tolerated as
+        logged retries up to the budget, then DEPARTED.
+        ``retry_records`` are the tolerated overruns (for
+        ``results["elastic"]["sync_retries"]`` accounting and logs)."""
         departed: list[int] = []
+        crashed: list[int] = []
         retries: list[dict] = []
         for wid, wall in zip(worker_ids, np.asarray(walls, np.float64)):
+            if not np.isfinite(wall):
+                crashed.append(int(wid))
+                self._attempts.pop(wid, None)
+                continue
             dl = self.deadline(wid)
             if wall > dl:
                 k = self._attempts.get(wid, 0) + 1
@@ -308,7 +393,7 @@ class StragglerPolicy:
                                         self.deadline(wid), 3)})
             else:
                 self._attempts.pop(wid, None)
-        return departed, retries
+        return departed, crashed, retries
 
     def forget(self, worker: int) -> None:
         """Drop a departed/killed worker's attempt state."""

@@ -235,6 +235,7 @@ class CheckpointEngine:
         multi-host commit barrier is collective)."""
         t0 = time.perf_counter()
         self._finalize()
+        state = _strip_buddy(state)
         jax.block_until_ready(state)   # the donated-buffer snapshot fence
         pieces, meta = snapshot_addressable(state)
         snapshot_ms = round((time.perf_counter() - t0) * 1e3, 3)
@@ -616,6 +617,22 @@ def host_tree(path: str) -> tuple[dict[str, np.ndarray], int]:
     return out, int(manifest["global_epoch"])
 
 
+def _strip_buddy(state):
+    """Drop the ISSUE 12 buddy rows from a ``TrainState``-shaped tree.
+
+    The buddy copy is DERIVED state (ring-rolled shard-resident rows,
+    ``comms.derive_buddy``): persisting it would couple the checkpoint
+    layout to the redundancy flag for zero information.  Both the save
+    path and the restore template route through this, so checkpoints
+    are buddy-less whichever flag wrote or reads them; the engine
+    re-derives the copy after restore (``LocalSGDEngine.refresh_buddy``
+    / ``stage_state``)."""
+    if getattr(state, "buddy", None) is not None and hasattr(state,
+                                                             "replace"):
+        return state.replace(buddy=None)
+    return state
+
+
 def restore_checkpoint(path: str, state_template, *,
                        params_template=None, bucket_bytes: int | None = None):
     """Restore ``(state, global_epoch)`` from a checkpoint path.
@@ -637,6 +654,7 @@ def restore_checkpoint(path: str, state_template, *,
     the engine's) is required for the replicated->resident direction —
     bucket rows carry no leaf shapes; ``bucket_bytes`` defaults to the
     manifest's recorded ``sync_bucket_mb`` and then the engine default."""
+    state_template = _strip_buddy(state_template)
     if os.path.isdir(path):
         merged, epoch = host_tree(path)
         flat, treedef = jax.tree_util.tree_flatten_with_path(state_template)
@@ -852,6 +870,7 @@ def save_checkpoint_legacy(ckpt_dir: str, state, global_epoch: int) -> str:
     """The pre-engine blocking save (format 1): gather the FULL state to
     every host, serialize one msgpack inline.  Kept as the bench A/B twin
     and to manufacture legacy checkpoints for the back-compat tests."""
+    state = _strip_buddy(state)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
         host_state = multihost_utils.process_allgather(state, tiled=True)

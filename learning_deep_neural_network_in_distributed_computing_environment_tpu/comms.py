@@ -82,13 +82,22 @@ def _shift(x: jnp.ndarray, n: int, shift: int, axis_name: str) -> jnp.ndarray:
 
 def aggregate(tree: PyTree, *, how: str = "equal",
               topology: str = "allreduce", local_weight: float = 0.5,
-              axis_name: str = DATA_AXIS) -> PyTree:
+              axis_name: str = DATA_AXIS, poison=None):
     """Aggregate a per-worker pytree across the data axis.
 
     Must be called inside ``shard_map`` (or any context where ``axis_name``
     is bound).  Works on parameter or gradient pytrees alike — the
     gradients/weights choice ("aggregation_by") is the caller's, matching
     the reference's dispatch (``Balanced All-Reduce/trainer.py:141-150``).
+
+    ``poison`` (ISSUE 12 integrity screen): when not None, this worker's
+    contribution is screened sender-side (poisoned/non-finite values
+    enter the collectives as exact zeros) and every blend renormalizes
+    over the valid contributions — the dense twin of the fast engines'
+    screen, so the quarantine semantics are identical whichever sync
+    path a chaos run resolves.  Clean rounds select the unscreened
+    arithmetic (bitwise-identical).  The return is then
+    ``(aggregated, ok)`` with ``ok`` this worker's fp32 0/1 flag.
     """
     if how not in HOWS:
         raise ValueError(f"how must be one of {HOWS}, got {how!r}")
@@ -96,29 +105,82 @@ def aggregate(tree: PyTree, *, how: str = "equal",
         raise ValueError(f"topology must be one of {TOPOLOGIES}, got {topology!r}")
     n = axis_size(axis_name)
     if n == 1:
+        if poison is not None:
+            ok1 = _contribution_ok(
+                poison, jax.tree_util.tree_leaves(tree), None)
+            return tree, ok1.astype(jnp.float32)
         return tree
     w = local_weight
+    ok = okf = valid = None
+    if poison is not None:
+        ok = _contribution_ok(poison, jax.tree_util.tree_leaves(tree),
+                              None)
+        okf = ok.astype(jnp.float32)
+        valid = jnp.maximum(lax.psum(okf, axis_name), 1.0)
+        all_ok = valid >= n
+        ok1f = _shift(okf, n, 1, axis_name)
+        ok2f = (_shift(okf, n, 2, axis_name)
+                if topology == "double_ring" else None)
 
     def per_leaf(x: jnp.ndarray) -> jnp.ndarray:
+        xs = x if ok is None else jnp.where(ok, x, jnp.zeros_like(x))
         if topology == "allreduce":
             if how == "equal":
-                return lax.pmean(x, axis_name)
-            total = lax.psum(x, axis_name)
+                out = lax.pmean(x, axis_name)
+                if ok is None:
+                    return out
+                return jnp.where(all_ok, out,
+                                 lax.psum(xs, axis_name) / valid)
+            total = lax.psum(xs, axis_name)
             peers_mean = (total - x) / (n - 1)
-            return w * x + (1.0 - w) * peers_mean
+            out = w * x + (1.0 - w) * peers_mean
+            if ok is None:
+                return out
+            peers = jnp.maximum(valid - 1.0, 1.0)
+            screened = jnp.where(
+                ok, w * x + (1.0 - w) * (total - xs) / peers,
+                total / valid)
+            return jnp.where(all_ok, out, screened)
         if topology == "ring":
-            r = _shift(x, n, 1, axis_name)
+            r = _shift(xs, n, 1, axis_name)
+            out = (x + r) / 2.0 if how == "equal" \
+                else w * x + (1.0 - w) * r
+            if ok is None:
+                return out
+            r_ok = ok1f > 0
             if how == "equal":
-                return (x + r) / 2.0
-            return w * x + (1.0 - w) * r
+                cnt = okf + ok1f
+                screened = jnp.where(
+                    cnt > 0, (xs + r) / jnp.maximum(cnt, 1.0), x)
+            else:
+                screened = jnp.where(
+                    jnp.logical_and(ok, r_ok), out,
+                    jnp.where(r_ok, r, x))
+            return jnp.where(jnp.logical_and(ok, r_ok), out, screened)
         # double_ring: blend with the two predecessors
-        r1 = _shift(x, n, 1, axis_name)
-        r2 = _shift(x, n, 2, axis_name)
+        r1 = _shift(xs, n, 1, axis_name)
+        r2 = _shift(xs, n, 2, axis_name)
+        out = (x + r1 + r2) / 3.0 if how == "equal" \
+            else w * x + ((1.0 - w) / 2.0) * (r1 + r2)
+        if ok is None:
+            return out
+        every = jnp.logical_and(ok, jnp.logical_and(ok1f > 0, ok2f > 0))
+        cnt = okf + ok1f + ok2f
         if how == "equal":
-            return (x + r1 + r2) / 3.0
-        return w * x + ((1.0 - w) / 2.0) * (r1 + r2)
+            screened = jnp.where(
+                cnt > 0, (xs + r1 + r2) / jnp.maximum(cnt, 1.0), x)
+        else:
+            pc = ok1f + ok2f
+            pmean = (r1 + r2) / jnp.maximum(pc, 1.0)
+            screened = jnp.where(
+                ok, jnp.where(pc > 0, w * x + (1.0 - w) * pmean, x),
+                jnp.where(pc > 0, pmean, x))
+        return jnp.where(every, out, screened)
 
-    return jax.tree_util.tree_map(per_leaf, tree)
+    agg = jax.tree_util.tree_map(per_leaf, tree)
+    if poison is not None:
+        return agg, okf
+    return agg
 
 
 def _wire_codec(wdt):
@@ -499,6 +561,190 @@ def resident_relayout(resident: dict, per_worker_template: PyTree,
     return out
 
 
+def buddy_wire_bytes(tree: PyTree, n: int, *, wire_dtype=None,
+                     bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+                     params: bool = True, tracker: bool = False,
+                     ef: bool = False) -> int:
+    """Per-worker bytes SENT by the ISSUE 12 buddy-redundancy hop —
+    ONE extra ppermute per bucket at scatter exit, carrying exactly the
+    shard-resident rows: the ``padded/N`` resident params row in the
+    WIRE dtype (``params``), the two fp32 tracker rows (``tracker``),
+    and the fp32 residual own-span (``ef``).  Zero when nothing is
+    shard-resident (n <= 1 or an empty tree) — the accounting twin of
+    ``sync_wire_bytes``, asserted in tests/test_sync.py."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves or n <= 1:
+        return 0
+    total = 0
+    for b in bucket_plan(leaves, n, bucket_bytes):
+        row = b.padded // n
+        wire_item = (jnp.dtype(wire_dtype).itemsize
+                     if wire_dtype is not None else b.dtype.itemsize)
+        if params:
+            total += row * wire_item
+        if ef:
+            total += row * 4
+        if tracker:
+            total += 2 * row * 4
+    return total
+
+
+def derive_buddy(per_worker_template: PyTree, n: int, *,
+                 bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+                 params_resident: dict | None = None,
+                 round_opt: dict | None = None,
+                 residual: PyTree | None = None,
+                 opt_placement: str = "sharded") -> dict | None:
+    """HOST: the buddy layout implied by a state's shard-resident rows
+    (ISSUE 12) — ``buddy[bucket][comp][w]`` is worker ``(w-1) % n``'s
+    component row, exactly what the device hop's ring ppermute delivers
+    (``ring_neighbors(n, 1)``: every worker holds its PREDECESSOR's
+    spans).
+
+    Used wherever the state is (re)built on host and the device copy
+    does not exist yet: engine init, checkpoint restore (buddy rows are
+    STRIPPED from checkpoints — they are derivable, and saving them
+    would couple the manifest layout to the redundancy flag), and the
+    elastic re-tile.  ``residual`` contributes each worker's OWN-span
+    slice of its packed fp32 residual (the span carrying the stage-2
+    consensus correction).  Returns None when nothing is
+    shard-resident."""
+    import numpy as np
+
+    if n < 2:
+        return None
+    leaves = jax.tree_util.tree_leaves(per_worker_template)
+    if not leaves:
+        return None
+    res_rows = (None if residual is None
+                else [np.asarray(x) for x in
+                      jax.tree_util.tree_leaves(residual)])
+    tracker_on = round_opt is not None and opt_placement == "sharded"
+    if params_resident is None and res_rows is None and not tracker_on:
+        return None
+    out: dict = {}
+    for i, b in enumerate(bucket_plan(leaves, n, bucket_bytes)):
+        name = _bucket_name(i)
+        row = b.padded // n
+        bud: dict = {}
+        if params_resident is not None:
+            arr = np.asarray(params_resident[name])
+            if arr.shape != (n, row):
+                raise ValueError(
+                    f"resident params bucket {name} has shape "
+                    f"{arr.shape}, expected {(n, row)}")
+            bud["params"] = np.roll(arr, 1, axis=0).copy()
+        if res_rows is not None:
+            mat = np.zeros((n, b.padded), np.float32)
+            for (j, off, size) in b.items:
+                mat[:, off:off + size] = res_rows[j].reshape(n, -1)
+            spans = np.stack([mat[w, w * row:(w + 1) * row]
+                              for w in range(n)])
+            bud["res"] = np.roll(spans, 1, axis=0).copy()
+        if tracker_on:
+            for m in ("mu", "nu"):
+                arr = np.asarray(round_opt[name][m])
+                if arr.shape != (n, row):
+                    raise ValueError(
+                        f"round-opt bucket {name}/{m} has shape "
+                        f"{arr.shape}, expected {(n, row)} (buddy "
+                        "redundancy covers the SHARDED placement)")
+                bud[m] = np.roll(arr, 1, axis=0).copy()
+        out[name] = bud
+    return out
+
+
+def buddy_restore_rows(host_state_parts: dict, buddy: dict,
+                       lost_positions: list[int],
+                       per_worker_template: PyTree, *,
+                       bucket_bytes: int = DEFAULT_BUCKET_BYTES) -> dict:
+    """HOST: reconstruct CRASHED workers' shard-resident rows from their
+    buddy copies (ISSUE 12 recovery).
+
+    ``host_state_parts`` maps component name -> layout:
+    ``{"params_resident": {bucket: [n, row]},
+       "round_opt": {bucket: {"mu"/"nu": [n, row]}},
+       "residual": params-shaped [n, ...] pytree}`` (absent components
+    omitted).  For each lost position ``p`` the holder is ``(p+1) % n``
+    — its buddy row IS the lost worker's span, by the ring hop's
+    construction.  A holder that is itself lost is a DOUBLE FAULT and
+    raises (the caller falls back to the newest committed checkpoint).
+    The residual component is FOLDED into the holder's own residual at
+    the lost span's positions (the pending stage-2 consensus correction
+    survives the crash instead of vanishing with the row); resident
+    params / tracker rows are patched in place.  Returns the patched
+    ``host_state_parts`` (new arrays, inputs untouched)."""
+    import numpy as np
+
+    leaves = jax.tree_util.tree_leaves(per_worker_template)
+    resident = host_state_parts.get("params_resident")
+    round_opt = host_state_parts.get("round_opt")
+    residual = host_state_parts.get("residual")
+    n = None
+    for comp in (resident, round_opt):
+        if comp:
+            first = next(iter(comp.values()))
+            arr = first.get("mu") if isinstance(first, dict) else first
+            n = int(np.shape(arr)[0])
+            break
+    if n is None and residual is not None:
+        n = int(np.shape(jax.tree_util.tree_leaves(residual)[0])[0])
+    if n is None:
+        raise ValueError("nothing shard-resident to restore")
+    lost = sorted(set(int(p) for p in lost_positions))
+    for p in lost:
+        if not 0 <= p < n:
+            raise ValueError(f"lost position {p} outside worker axis {n}")
+        holder = (p + 1) % n
+        if holder in lost:
+            raise ValueError(
+                f"double fault: crashed worker at position {p} and its "
+                f"buddy at position {holder} are both lost — the span "
+                "exists nowhere in memory (fall back to the newest "
+                "committed checkpoint)")
+    plan = bucket_plan(leaves, n, bucket_bytes)
+    out = dict(host_state_parts)
+    if resident is not None:
+        patched = {k: np.asarray(v).copy() for k, v in resident.items()}
+        for i, b in enumerate(plan):
+            name = _bucket_name(i)
+            for p in lost:
+                patched[name][p] = np.asarray(
+                    buddy[name]["params"])[(p + 1) % n]
+        out["params_resident"] = patched
+    if round_opt is not None and any(
+            "mu" in bud for bud in buddy.values()):
+        patched = {k: {m: np.asarray(v).copy() for m, v in d.items()}
+                   for k, d in round_opt.items()}
+        for i, b in enumerate(plan):
+            name = _bucket_name(i)
+            for p in lost:
+                for m in ("mu", "nu"):
+                    patched[name][m][p] = np.asarray(
+                        buddy[name][m])[(p + 1) % n]
+        out["round_opt"] = patched
+    if residual is not None and any(
+            "res" in bud for bud in buddy.values()):
+        res_leaves, res_def = jax.tree_util.tree_flatten(residual)
+        res_leaves = [np.asarray(x).copy() for x in res_leaves]
+        for i, b in enumerate(plan):
+            name = _bucket_name(i)
+            row = b.padded // n
+            for p in lost:
+                holder = (p + 1) % n
+                span = np.asarray(buddy[name]["res"])[holder]
+                lo, hi = p * row, (p + 1) * row
+                for (j, off, size) in b.items:
+                    a, z = max(off, lo), min(off + size, hi)
+                    if a >= z:
+                        continue
+                    flat = res_leaves[j][holder].reshape(-1)
+                    flat[a - off:z - off] += span[a - lo:z - lo]
+        out["residual"] = jax.tree_util.tree_unflatten(res_def,
+                                                       res_leaves)
+    return out
+
+
 def resident_gather(shards: dict, per_worker_template: PyTree, *,
                     axis_name: str = DATA_AXIS,
                     bucket_bytes: int = DEFAULT_BUCKET_BYTES) -> PyTree:
@@ -561,14 +807,32 @@ def make_resident_gather(mesh, per_worker_template: PyTree, *,
     return jax.jit(_gather, donate_argnums=(0,) if donate else ())
 
 
+def _contribution_ok(poison, leaves, res_leaves):
+    """Per-worker validity of this worker's sync contribution (ISSUE 12
+    integrity screen): not poisoned AND every leaf (plus the EF residual
+    it folds in) entirely finite.  A scalar bool, computed inside
+    shard_map."""
+    ok = jnp.logical_not(jnp.asarray(poison, bool).reshape(()))
+    for x in leaves:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(
+            x.astype(jnp.float32))))
+    if res_leaves is not None:
+        for x in res_leaves:
+            ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(
+                x.astype(jnp.float32))))
+    return ok
+
+
 def sharded_opt_sync(tree: PyTree, *, how: str = "equal",
                      local_weight: float = 0.5, axis_name: str = DATA_AXIS,
                      wire_dtype=None, residual: PyTree | None = None,
                      bucket_bytes: int = DEFAULT_BUCKET_BYTES,
                      opt_placement: str = "sharded",
                      tracker: dict | None = None,
-                     residency: str = "replicated"
-                     ) -> tuple[PyTree, PyTree | None, dict | None]:
+                     residency: str = "replicated",
+                     buddy: bool = False,
+                     poison=None
+                     ) -> tuple:
     """``sharded_sync`` with the full apply-stage surface (ISSUE 9):
     optimizer placement plus the round-level Adam moment tracker.
 
@@ -592,8 +856,32 @@ def sharded_opt_sync(tree: PyTree, *, how: str = "equal",
     slice of the bucket shard it owns (1/N state, 1/N FLOPs); under
     ``"replicated"`` every worker updates the full vector from the
     gathered sums — N identical copies of the same arithmetic, kept as
-    the bitwise A/B twin.  Returns
-    ``(synced, new_residual, new_tracker)``."""
+    the bitwise A/B twin.
+
+    ``buddy`` (ISSUE 12) fuses ONE extra per-bucket ppermute hop at
+    scatter exit: each worker also sends its post-apply resident shard
+    row (the ``residency="resident"`` output — the WIRE-dtype payload
+    plus its scale, decoded buddy-side, so the copy is bitwise the
+    owner's row), the sharded tracker's new mu/nu rows, and (under EF)
+    the owned span of its fp32 residual to its ring SUCCESSOR
+    (``ring_neighbors(n, 1)``) — so every 1/N span of shard-resident
+    state lives on exactly two workers and an abrupt worker loss is
+    recoverable from the buddy copy.  Pure data movement: every other
+    output is bitwise-unchanged.
+
+    ``poison`` (ISSUE 12 integrity screen) is this worker's scalar
+    poison flag: when not None, each worker's contribution is screened
+    sender-side (poisoned or non-finite contributions enter the
+    collectives as exact zeros) and the blend renormalizes over the
+    count of valid workers — the quarantined worker receives the
+    survivors' consensus.  When every worker is valid the outputs are
+    bitwise-identical to the unscreened program (the screened branch is
+    selected away by a ``where`` on the full-count predicate).
+
+    Returns ``(synced, new_residual, new_tracker)``, with the buddy
+    layout appended when ``buddy`` and this worker's validity flag (an
+    fp32 0/1 scalar) appended when ``poison is not None`` — callers
+    unpack exactly what they armed."""
     if how not in HOWS:
         raise ValueError(f"how must be one of {HOWS}, got {how!r}")
     if opt_placement not in OPT_PLACEMENTS:
@@ -615,11 +903,18 @@ def sharded_opt_sync(tree: PyTree, *, how: str = "equal",
             "combinations to the replicated residency)")
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     n = axis_size(axis_name)
+    if buddy and n < 2:
+        raise ValueError(
+            "buddy redundancy needs a worker axis of size >= 2 (a lone "
+            "worker has no ring successor to back its shard up on)")
     if not leaves or n == 1:
         if resident:
             raise ValueError(
                 "a scatter-resident output needs a worker axis of size "
                 ">= 2 and a non-empty tree (nothing to shard)")
+        if poison is not None:
+            ok1 = _contribution_ok(poison, leaves, None)
+            return tree, residual, tracker, ok1.astype(jnp.float32)
         return tree, residual, tracker
     res_leaves = None
     if residual is not None:
@@ -628,6 +923,14 @@ def sharded_opt_sync(tree: PyTree, *, how: str = "equal",
             raise ValueError(
                 "residual must mirror the synced tree: "
                 f"{len(res_leaves)} leaves vs {len(leaves)}")
+    ok = okf = valid = None
+    if poison is not None:
+        ok = _contribution_ok(poison, leaves, res_leaves)
+        okf = ok.astype(jnp.float32)
+        valid = jnp.maximum(lax.psum(okf, axis_name), 1.0)
+        all_ok = valid >= n   # every contribution finite -> the
+        #                       unscreened arithmetic is selected below,
+        #                       so clean rounds stay bitwise-identical
     compressed_wire = (wire_dtype is not None
                        and jnp.dtype(wire_dtype) != jnp.dtype(jnp.float32))
     if compressed_wire and opt_placement != "sharded":
@@ -637,6 +940,7 @@ def sharded_opt_sync(tree: PyTree, *, how: str = "equal",
             f"must be 'sharded', got {opt_placement!r}")
     new_tracker: dict | None = {} if tracker is not None else None
     resident_out: dict = {}
+    buddy_out: dict = {}
     out: list = [None] * len(leaves)
     new_res: list | None = [None] * len(leaves) if res_leaves is not None \
         else None
@@ -652,6 +956,13 @@ def sharded_opt_sync(tree: PyTree, *, how: str = "equal",
         if b.padded > filled:
             parts.append(jnp.zeros((b.padded - filled,), jnp.float32))
         buf = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+        if ok is not None:
+            # sender-side quarantine: a poisoned/non-finite contribution
+            # enters the collectives as exact zeros (where, not a
+            # multiply — NaN payloads must not leak through 0 * NaN);
+            # the worker's EF residual resets with it (err below is then
+            # exactly zero, a fresh EF start after the bad round)
+            buf = jnp.where(ok, buf, jnp.zeros_like(buf))
         wdt = jnp.dtype(wire_dtype) if wire_dtype is not None else b.dtype
         quantized, encode = _wire_codec(wdt)
 
@@ -703,14 +1014,23 @@ def sharded_opt_sync(tree: PyTree, *, how: str = "equal",
                 # arithmetic.  Elementwise scaling commutes with the
                 # gather bit-for-bit, so the result is bitwise-identical
                 # to the shard-resident apply below.
-                full = lax.all_gather(shard32, axis_name,
-                                      tiled=True).astype(jnp.float32) / n
+                gathered = lax.all_gather(shard32, axis_name,
+                                          tiled=True).astype(jnp.float32)
+                full = gathered / n
+                if ok is not None:
+                    # quarantine renormalization: the screened sum holds
+                    # only the valid contributions, so the mean divides
+                    # by their count; the full-count predicate keeps
+                    # clean rounds on the literal-n division (bitwise)
+                    full = jnp.where(all_ok, full, gathered / valid)
                 track32 = full
             else:
                 # shard-resident apply: the scale (and, compressed, the
                 # mean's wire encode + stage-2 EF) runs on the 1/N shard;
                 # only the post-update values ride the all_gather home
                 mean32 = shard32 / n
+                if ok is not None:
+                    mean32 = jnp.where(all_ok, mean32, shard32 / valid)
                 mean, mean32_dec, mean_scale = encode(mean32)
                 if new_res is not None and compressed:
                     # second-stage error feedback: the gathered mean is
@@ -734,6 +1054,31 @@ def sharded_opt_sync(tree: PyTree, *, how: str = "equal",
                     # on a compressed wire
                     resident_out[_bucket_name(bi)] = mean32_dec
                     full = None
+                    if buddy:
+                        # ISSUE 12 buddy hop, fused at scatter exit: the
+                        # WIRE-dtype payload (+ its scale) rides one
+                        # ppermute to the ring successor and decodes
+                        # there — the buddy copy is bitwise the owner's
+                        # resident row (decode is a pure function of the
+                        # permuted payload), at wire-dtype hop cost
+                        nb = ring_neighbors(n, 1)
+                        brow = lax.ppermute(mean, axis_name, nb)
+                        if quantized:
+                            bsc = lax.ppermute(mean_scale, axis_name, nb)
+                            b32 = brow.astype(jnp.float32) * bsc
+                        else:
+                            b32 = brow.astype(jnp.float32)
+                        bud = {"params": b32}
+                        if new_res is not None:
+                            # the owned span of the fp32 residual carries
+                            # the stage-2 consensus correction (n x e2 at
+                            # this worker's scatter positions) — state no
+                            # other worker holds; back it up alongside
+                            row = b.padded // n
+                            span = lax.dynamic_slice_in_dim(
+                                err, lax.axis_index(axis_name) * row, row)
+                            bud["res"] = lax.ppermute(span, axis_name, nb)
+                        buddy_out[_bucket_name(bi)] = bud
                 else:
                     full = gather_decoded(mean, mean_scale)
                 track32 = mean32
@@ -752,6 +1097,21 @@ def sharded_opt_sync(tree: PyTree, *, how: str = "equal",
             full = w * own + (1.0 - w) * (total - own) / (n - 1)
             track32 = (shard32 / n if opt_placement == "sharded"
                        else total / n)
+            if ok is not None:
+                # quarantine under the weighted blend: a valid worker's
+                # peer mean renormalizes over the valid peer count (its
+                # own screened term is already in total); a quarantined
+                # worker adopts the valid consensus mean — its own value
+                # is the garbage being quarantined
+                peers = jnp.maximum(valid - 1.0, 1.0)
+                screened = jnp.where(
+                    ok, w * own + (1.0 - w) * (total - own) / peers,
+                    total / valid)
+                full = jnp.where(all_ok, full, screened)
+                track32 = jnp.where(
+                    all_ok, track32,
+                    (shard32 if opt_placement == "sharded" else total)
+                    / valid)
         if new_tracker is not None:
             # round-level Adam moments of the cross-worker mean — the
             # worker-invariant quantity whose state the sharded placement
@@ -775,6 +1135,16 @@ def sharded_opt_sync(tree: PyTree, *, how: str = "equal",
             new_tracker[name] = {
                 "mu": ROUND_ADAM_B1 * mu + (1.0 - ROUND_ADAM_B1) * g,
                 "nu": ROUND_ADAM_B2 * nu + (1.0 - ROUND_ADAM_B2) * (g * g)}
+            if buddy and opt_placement == "sharded":
+                # ISSUE 12: the sharded tracker rows are 1/N state no
+                # other worker holds — one fp32 ppermute each backs the
+                # fresh moments up on the ring successor
+                nb = ring_neighbors(n, 1)
+                buddy_out.setdefault(name, {}).update(
+                    mu=lax.ppermute(new_tracker[name]["mu"], axis_name,
+                                    nb),
+                    nu=lax.ppermute(new_tracker[name]["nu"], axis_name,
+                                    nb))
         for (i, off, size) in b.items:
             leaf = leaves[i]
             if full is not None:
@@ -784,10 +1154,14 @@ def sharded_opt_sync(tree: PyTree, *, how: str = "equal",
                 new_res[i] = err[off:off + size].reshape(leaf.shape)
     res_out = (residual if new_res is None
                else jax.tree_util.tree_unflatten(treedef, new_res))
-    if resident:
-        return resident_out, res_out, new_tracker
-    synced = jax.tree_util.tree_unflatten(treedef, out)
-    return synced, res_out, new_tracker
+    first = (resident_out if resident
+             else jax.tree_util.tree_unflatten(treedef, out))
+    ret: list = [first, res_out, new_tracker]
+    if buddy:
+        ret.append(buddy_out)
+    if poison is not None:
+        ret.append(okf)
+    return tuple(ret)
 
 
 # --------------------------------------------------------------------------
@@ -824,8 +1198,8 @@ def sharded_opt_sync(tree: PyTree, *, how: str = "equal",
 def gossip_sync(tree: PyTree, *, topology: str, how: str = "equal",
                 local_weight: float = 0.5, axis_name: str = DATA_AXIS,
                 wire_dtype=None, residual: PyTree | None = None,
-                bucket_bytes: int = DEFAULT_BUCKET_BYTES
-                ) -> tuple[PyTree, PyTree | None]:
+                bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+                poison=None) -> tuple:
     """One bucketed ring/double-ring gossip round over the data axis.
 
     Must be called inside ``shard_map`` (``axis_name`` bound), like
@@ -846,6 +1220,16 @@ def gossip_sync(tree: PyTree, *, topology: str, how: str = "equal",
     ``(blended_tree, new_residual)``; ``new_residual`` is ``residual``
     unchanged (possibly None) when no error feedback is active.
 
+    ``poison`` (ISSUE 12 integrity screen): when not None, each
+    worker's TRANSMISSION is screened sender-side (poisoned/non-finite
+    payloads travel as exact zeros, the validity flag ppermutes
+    alongside) and the blend renormalizes over the valid terms — a
+    worker whose predecessor is quarantined keeps its own value, a
+    quarantined worker adopts its valid neighbor terms.  Clean rounds
+    select the unscreened arithmetic (bitwise-identical).  The return
+    gains this worker's fp32 0/1 validity flag:
+    ``(blended, new_residual, ok)``.
+
     Double-ring issues the shift-1 and shift-2 exchanges back to back and
     fences them with ``optimization_barrier`` before either blend term is
     consumed, so the shift-2 hop rides the wire while the shift-1 blend
@@ -860,6 +1244,9 @@ def gossip_sync(tree: PyTree, *, topology: str, how: str = "equal",
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     n = axis_size(axis_name)
     if not leaves or n == 1:
+        if poison is not None:
+            ok1 = _contribution_ok(poison, leaves, None)
+            return tree, residual, ok1.astype(jnp.float32)
         return tree, residual
     res_leaves = None
     if residual is not None:
@@ -868,6 +1255,10 @@ def gossip_sync(tree: PyTree, *, topology: str, how: str = "equal",
             raise ValueError(
                 "residual must mirror the synced tree: "
                 f"{len(res_leaves)} leaves vs {len(leaves)}")
+    ok = okf = None
+    if poison is not None:
+        ok = _contribution_ok(poison, leaves, res_leaves)
+        okf = ok.astype(jnp.float32)
     out: list = [None] * len(leaves)
     new_res: list | None = [None] * len(leaves) if res_leaves is not None \
         else None
@@ -885,6 +1276,11 @@ def gossip_sync(tree: PyTree, *, topology: str, how: str = "equal",
                          for (i, _off, _size) in b.items]
             send = buf + (jnp.concatenate(res_parts) if len(res_parts) > 1
                           else res_parts[0])
+        if ok is not None:
+            # sender-side quarantine: a poisoned/non-finite transmission
+            # travels as exact zeros, and the validity flag ppermutes
+            # alongside so receivers renormalize their blend terms
+            send = jnp.where(ok, send, jnp.zeros_like(send))
         wdt = jnp.dtype(wire_dtype) if wire_dtype is not None else b.dtype
         quantized, encode = _wire_codec(wdt)
         sent, sent32, sent_scale = encode(send)
@@ -895,22 +1291,42 @@ def gossip_sync(tree: PyTree, *, topology: str, how: str = "equal",
             err = send - sent32
 
         def hop(shift):
-            """Permuted (payload, scale) from the shift-th predecessor;
-            int8 payloads travel with their sender's fp32 scale."""
+            """Permuted (payload, scale, validity) from the shift-th
+            predecessor; int8 payloads travel with their sender's fp32
+            scale."""
             r = _shift(sent, n, shift, axis_name)
             s = _shift(sent_scale, n, shift, axis_name) if quantized \
                 else None
-            return r, s
+            o = _shift(okf, n, shift, axis_name) if okf is not None \
+                else None
+            return r, s, o
 
-        def dec(pair):
-            r, s = pair
+        def dec(trip):
+            r, s, _o = trip
             r32 = r.astype(jnp.float32)
             return r32 * s if s is not None else r32
 
         if topology == "ring":
-            r1 = dec(hop(1))
+            h1 = hop(1)
+            r1 = dec(h1)
             blended = (buf + r1) / 2.0 if how == "equal" \
                 else w * buf + (1.0 - w) * r1
+            if ok is not None:
+                r1ok = h1[2] > 0
+                safe_buf = jnp.where(ok, buf, jnp.zeros_like(buf))
+                if how == "equal":
+                    num = safe_buf + jnp.where(r1ok, r1,
+                                               jnp.zeros_like(r1))
+                    cnt = okf + h1[2]
+                    screened = jnp.where(cnt > 0,
+                                         num / jnp.maximum(cnt, 1.0), buf)
+                else:
+                    screened = jnp.where(
+                        jnp.logical_and(ok, r1ok),
+                        w * buf + (1.0 - w) * r1,
+                        jnp.where(r1ok, r1, buf))
+                blended = jnp.where(jnp.logical_and(ok, r1ok), blended,
+                                    screened)
         else:
             # both shifts issued before either blend term is consumed:
             # the barrier keeps XLA from serializing the shift-2
@@ -922,6 +1338,28 @@ def gossip_sync(tree: PyTree, *, topology: str, how: str = "equal",
             # fp32 bit-identity guarantee
             blended = (buf + r1 + r2) / 3.0 if how == "equal" \
                 else w * buf + ((1.0 - w) / 2.0) * (r1 + r2)
+            if ok is not None:
+                r1ok, r2ok = h1[2] > 0, h2[2] > 0
+                every = jnp.logical_and(ok, jnp.logical_and(r1ok, r2ok))
+                safe_buf = jnp.where(ok, buf, jnp.zeros_like(buf))
+                num = (safe_buf
+                       + jnp.where(r1ok, r1, jnp.zeros_like(r1))
+                       + jnp.where(r2ok, r2, jnp.zeros_like(r2)))
+                cnt = okf + h1[2] + h2[2]
+                if how == "equal":
+                    screened = jnp.where(cnt > 0,
+                                         num / jnp.maximum(cnt, 1.0), buf)
+                else:
+                    pn = (jnp.where(r1ok, r1, jnp.zeros_like(r1))
+                          + jnp.where(r2ok, r2, jnp.zeros_like(r2)))
+                    pc = h1[2] + h2[2]
+                    pmean = pn / jnp.maximum(pc, 1.0)
+                    screened = jnp.where(
+                        ok,
+                        jnp.where(pc > 0, w * buf + (1.0 - w) * pmean,
+                                  buf),
+                        jnp.where(pc > 0, pmean, buf))
+                blended = jnp.where(every, blended, screened)
         for (i, off, size) in b.items:
             leaf = leaves[i]
             out[i] = blended[off:off + size].reshape(leaf.shape).astype(
@@ -929,9 +1367,11 @@ def gossip_sync(tree: PyTree, *, topology: str, how: str = "equal",
             if new_res is not None:
                 new_res[i] = err[off:off + size].reshape(leaf.shape)
     synced = jax.tree_util.tree_unflatten(treedef, out)
-    if new_res is None:
-        return synced, residual
-    return synced, jax.tree_util.tree_unflatten(treedef, new_res)
+    res_out = (residual if new_res is None
+               else jax.tree_util.tree_unflatten(treedef, new_res))
+    if poison is not None:
+        return synced, res_out, okf
+    return synced, res_out
 
 
 def make_host_sync(mesh, *, mode: str = "sharded", how: str = "equal",
@@ -940,7 +1380,9 @@ def make_host_sync(mesh, *, mode: str = "sharded", how: str = "equal",
                    topology: str = "allreduce",
                    opt_placement: str = "sharded",
                    track_opt: bool = False,
-                   param_residency: str = "replicated"):
+                   param_residency: str = "replicated",
+                   redundancy: str = "off",
+                   screen: bool = False):
     """Jitted stand-alone round sync over worker-stacked pytrees.
 
     The sync-engine twin of ``make_host_aggregator`` (tests, bench A/Bs,
@@ -965,6 +1407,13 @@ def make_host_sync(mesh, *, mode: str = "sharded", how: str = "equal",
     worker-stacked resident layout (``{bucket: [n, padded // n]}``)
     instead of the synced tree — feed it to ``make_resident_gather`` to
     reconstruct the full tree bit-for-bit.
+
+    ``redundancy="buddy"`` / ``screen=True`` (ISSUE 12) arm the buddy
+    hop and the NaN/Inf integrity screen; the returned callable then
+    takes ``(tree, residual=None, tracker=None, poison=None)`` and
+    returns a DICT ``{"out", "residual", "tracker", "buddy", "ok"}``
+    (keys present per arming) — the unit-test surface for the
+    failure-domain program shapes.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -972,46 +1421,97 @@ def make_host_sync(mesh, *, mode: str = "sharded", how: str = "equal",
         raise ValueError(
             "param_residency 'resident' is a sharded-engine output "
             f"layout; mode {mode!r} has no scatter to end at")
+    buddy_on = redundancy == "buddy"
+    if buddy_on and mode != "sharded":
+        raise ValueError(
+            "buddy redundancy backs up shard-resident rows, which only "
+            f"the sharded engine produces; mode {mode!r} has none")
+    if buddy_on and param_residency != "resident" and not (
+            track_opt and opt_placement == "sharded"):
+        raise ValueError(
+            "buddy redundancy needs something shard-resident: "
+            "param_residency 'resident' and/or a sharded-placement "
+            "tracker (track_opt=True)")
     spec = P(DATA_AXIS)
 
-    def _sync(tree, residual, tracker):
-        def inner(shard, res, trk):
+    def _sync(tree, residual, tracker, poison):
+        def inner(shard, res, trk, poi):
             sq = lambda t: jax.tree_util.tree_map(lambda x: x[0], t)
             ex = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
             # squeeze the tracker too: the dense/gossip branches pass it
             # through untouched, and ``ex`` below must restore exactly
             # the worker-stacked layout it arrived in
             t, r, new_t = sq(shard), sq(res), sq(trk)
+            # when the screen is armed the wrapper below guarantees a
+            # poison vector (all-clear default), so poi is never None
+            p = sq(poi) if screen else None
+            extra: dict = {}
             if mode == "dense":
-                out, new_r = aggregate(
-                    t, how=how, topology=topology,
-                    local_weight=local_weight), r
+                if screen:
+                    out, okf = aggregate(
+                        t, how=how, topology=topology,
+                        local_weight=local_weight, poison=p)
+                    extra["ok"] = okf
+                else:
+                    out = aggregate(t, how=how, topology=topology,
+                                    local_weight=local_weight)
+                new_r = r
             elif mode == "gossip":
-                out, new_r = gossip_sync(
+                rets = gossip_sync(
                     t, topology=topology, how=how,
                     local_weight=local_weight, wire_dtype=wire_dtype,
-                    residual=r, bucket_bytes=bucket_bytes)
+                    residual=r, bucket_bytes=bucket_bytes,
+                    poison=p if screen else None)
+                out, new_r = rets[0], rets[1]
+                if screen:
+                    extra["ok"] = rets[2]
             else:
-                out, new_r, new_t = sharded_opt_sync(
+                rets = sharded_opt_sync(
                     t, how=how, local_weight=local_weight,
                     wire_dtype=wire_dtype, residual=r,
                     bucket_bytes=bucket_bytes,
                     opt_placement=opt_placement, tracker=new_t,
-                    residency=param_residency)
-            return ex(out), ex(new_r), ex(new_t)
-        return shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=(spec, spec, spec))(
-                             tree, residual, tracker)
+                    residency=param_residency, buddy=buddy_on,
+                    poison=p if screen else None)
+                out, new_r, new_t = rets[0], rets[1], rets[2]
+                idx = 3
+                if buddy_on:
+                    extra["buddy"] = rets[idx]
+                    idx += 1
+                if screen:
+                    extra["ok"] = rets[idx]
+            if not buddy_on and not screen:
+                return ex(out), ex(new_r), ex(new_t)
+            return {"out": ex(out), "residual": ex(new_r),
+                    "tracker": ex(new_t),
+                    **{k: ex(v) for k, v in extra.items()}}
+        n_in = 4 if (buddy_on or screen) else 3
+        args = (tree, residual, tracker, poison)[:n_in]
+        return shard_map(inner if n_in == 4 else
+                         (lambda a, b, c: inner(a, b, c, None)),
+                         mesh=mesh, in_specs=(spec,) * n_in,
+                         out_specs=spec if (buddy_on or screen)
+                         else (spec, spec, spec))(*args)
 
     jitted = jax.jit(_sync)
 
+    if buddy_on or screen:
+        n_workers = int(mesh.shape[DATA_AXIS])
+
+        def run_full(tree, residual=None, tracker=None, poison=None):
+            import numpy as np
+            if screen and poison is None:
+                poison = np.zeros(n_workers, np.bool_)
+            return jitted(tree, residual, tracker, poison)
+        return run_full
+
     if track_opt:
         def run_tracked(tree, residual=None, tracker=None):
-            return jitted(tree, residual, tracker)
+            return jitted(tree, residual, tracker, None)
         return run_tracked
 
     def run(tree, residual=None):
-        out, new_r, _ = jitted(tree, residual, None)
+        out, new_r, _ = jitted(tree, residual, None, None)
         return out, new_r
 
     return run

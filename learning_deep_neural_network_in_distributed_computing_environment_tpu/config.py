@@ -216,6 +216,25 @@ class Config:
     # identical to the replicated twin: the entry gather moves exactly
     # the bytes the exit gather used to (tests/test_param_residency.py).
     param_residency: str = "auto"    # auto | replicated | resident
+    # --- buddy-redundant resident shards (ISSUE 12) -------------------------
+    # shard_redundancy: whether the sync program keeps a second live copy
+    # of every SHARD-RESIDENT 1/N state span (scatter-resident params,
+    # the sharded round-optimizer rows, the EF residual's consensus
+    # span).  "buddy" fuses one extra per-bucket ppermute hop onto the
+    # donated sync program at scatter exit: every worker also receives
+    # its ring-PREDECESSOR's resident rows (comms.ring_neighbors is the
+    # buddy map), so each span lives on exactly two workers and an
+    # abrupt mid-round worker loss is recoverable entirely in memory —
+    # the crashed worker's spans are reconstructed from its buddy at the
+    # rollback boundary, no checkpoint-restore I/O on the recovery path.
+    # "auto" = buddy whenever any state actually resolves shard-resident
+    # (otherwise nothing is uniquely held and redundancy is a no-op);
+    # "off" disables the hop — a crash then degrades to the newest
+    # committed checkpoint (the double-fault ladder, logged + counted).
+    # The extra hop is pure data movement: the no-redundancy program's
+    # outputs are bitwise-unchanged, and the hop's wire bytes are
+    # accounted into sync_bytes (tests/test_sync.py).
+    shard_redundancy: str = "auto"   # auto | buddy | off
     # --- runtime sanitizer (ISSUE 6) ---------------------------------------
     # sanitize: arm the round-loop correctness harness — the driver wraps
     # every round dispatch/wait in jax.transfer_guard("disallow") (any
@@ -236,6 +255,13 @@ class Config:
     chaos: str = ""
     chaos_seed: int = 0           # random-mode schedule seed
     chaos_events: int = 4         # random-mode event count
+    # Random-mode kind selection (ISSUE 12 satellite): the kinds a
+    # `--chaos random` schedule may draw.  Defaults to the PR 8
+    # cooperative/timing faults; the unplanned-failure kinds
+    # (crash/nan) are opt-in — e.g. --chaos_kinds kill,join,crash,nan —
+    # so a random schedule never silently starts exercising the
+    # rollback-recovery machinery.  Scripted specs are unaffected.
+    chaos_kinds: str = "kill,join,slow,stall"
     # Straggler departure protocol (retry/timeout/backoff around the
     # round sync): a worker whose measured round wall exceeds
     # time_limit + chaos_grace*(1 + chaos_backoff*attempt) has overrun;
@@ -292,6 +318,8 @@ class Config:
                  ("auto", "replicated", "sharded"))
         _choices("param_residency", self.param_residency,
                  ("auto", "replicated", "resident"))
+        _choices("shard_redundancy", self.shard_redundancy,
+                 ("auto", "buddy", "off"))
         if self.grad_accum < 1:
             raise ValueError(
                 f"grad_accum must be >= 1, got {self.grad_accum}")
@@ -342,6 +370,17 @@ class Config:
                 "state; --opt_placement replicated applies post-gather "
                 "full-size and leaves no per-shard apply output to keep "
                 "resident")
+        if self.shard_redundancy == "buddy" and (
+                self.topology != "allreduce" or self.sync_mode == "dense"):
+            raise ValueError(
+                "--shard_redundancy buddy protects SHARD-RESIDENT state "
+                "(scatter-resident params / sharded round-optimizer "
+                "rows), which only the bucketed sharded allreduce engine "
+                f"produces; --topology {self.topology} / --sync_mode "
+                f"{self.sync_mode} keeps every state worker-local or "
+                "replicated — nothing is uniquely held, so there is "
+                "nothing for a buddy to back up (auto resolves this to "
+                "off)")
         if self.sync_compression == "ef" and not compressed_wire:
             raise ValueError(
                 "--sync_compression ef compensates compressed-wire "
@@ -388,6 +427,7 @@ class Config:
             # --chaos fails at argparse time, not at round boundary 3
             from .chaos import parse_chaos_spec
             parse_chaos_spec(self.chaos)
+        self.parse_chaos_kinds()   # validates the csv eagerly
         if self.chaos_events < 0 or self.chaos_retries < 0:
             raise ValueError(
                 f"chaos_events ({self.chaos_events}) and chaos_retries "
@@ -508,6 +548,30 @@ class Config:
         if self.param_residency == "replicated":
             return "replicated"
         return "resident"
+
+    def parse_chaos_kinds(self) -> tuple[str, ...]:
+        """``--chaos_kinds`` as a validated kind tuple (ISSUE 12
+        satellite): the kinds a ``--chaos random`` schedule may draw.
+        Order-preserving, duplicates collapsed; every entry must be a
+        known ``chaos.KINDS`` member so a typo'd selection fails at
+        argparse time, not mid-run."""
+        from .chaos import KINDS
+        out: list[str] = []
+        for part in self.chaos_kinds.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if part not in KINDS:
+                raise ValueError(
+                    f"unknown chaos kind {part!r} in --chaos_kinds "
+                    f"{self.chaos_kinds!r}: expected a subset of {KINDS}")
+            if part not in out:
+                out.append(part)
+        if not out:
+            raise ValueError(
+                f"--chaos_kinds {self.chaos_kinds!r} selects no event "
+                "kinds — a random schedule needs at least one")
+        return tuple(out)
 
     def parse_prompt_buckets(self) -> tuple[int, ...]:
         """``--serve_prompt_buckets`` as ascending unique lengths."""
@@ -725,6 +789,19 @@ def build_argparser() -> argparse.ArgumentParser:
                         "bucketed sharded engine syncs weights with the "
                         "equal blend (gossip/weighted/gradients states "
                         "are worker-local and stay replicated)")
+    p.add_argument("--shard_redundancy", type=str,
+                   default=d.shard_redundancy,
+                   choices=["auto", "buddy", "off"],
+                   help="buddy-redundant resident shards (unplanned-"
+                        "failure domain): buddy fuses one extra "
+                        "per-bucket ppermute onto the sync program at "
+                        "scatter exit so every 1/N resident span also "
+                        "lives on its ring successor — a mid-round "
+                        "worker crash recovers in memory from the buddy "
+                        "copy instead of a checkpoint restore; auto = "
+                        "buddy whenever any state resolves "
+                        "shard-resident; off = crash recovery degrades "
+                        "to the newest committed checkpoint")
     p.add_argument("--serve_max_batch", type=int, default=d.serve_max_batch,
                    help="serve: concurrent decode slots (the one fixed "
                         "shape the decode-step program compiles at)")
@@ -763,13 +840,19 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--chaos", type=str, default=d.chaos,
                    help="fault-injection plan: comma-separated "
                         "kind@round[:wID][xF][+S][*K] events (kill/join/"
-                        "slow/stall) or 'random' (seeded schedule); "
-                        "membership changes apply at round boundaries "
-                        "via the elastic reshard — no process restart")
+                        "slow/stall/crash/nan) or 'random' (seeded "
+                        "schedule); membership changes apply at round "
+                        "boundaries via the elastic reshard, crashes "
+                        "mid-round via the rollback recovery — no "
+                        "process restart")
     p.add_argument("--chaos_seed", type=int, default=d.chaos_seed,
                    help="seed for --chaos random's up-front event draw")
     p.add_argument("--chaos_events", type=int, default=d.chaos_events,
                    help="event count for --chaos random")
+    p.add_argument("--chaos_kinds", type=str, default=d.chaos_kinds,
+                   help="event kinds --chaos random may draw (csv; "
+                        "crash/nan are opt-in — the default keeps the "
+                        "cooperative kill/join/slow/stall faults)")
     p.add_argument("--chaos_grace", type=float, default=d.chaos_grace,
                    help="seconds past --time_limit before a round wall "
                         "counts as a straggler overrun")

@@ -127,7 +127,8 @@ def build_model_for(cfg: Config, num_classes: int, **extra):
 
 def checkpoint_metadata(cfg: Config, num_classes: int,
                         scan_layers: bool,
-                        param_residency: str | None = None) -> dict:
+                        param_residency: str | None = None,
+                        params_template=None) -> dict:
     """The arch facts MANIFEST.json carries so ``serve`` (and future
     inspection tools) rebuild the trained model straight from a checkpoint
     directory instead of the user restating ``--model``/layer flags
@@ -145,7 +146,7 @@ def checkpoint_metadata(cfg: Config, num_classes: int,
     manifest must describe the layout actually saved (serve keys its
     resident-checkpoint rejection off it) — the config resolution is
     only the mesh-blind fallback."""
-    return {"model": cfg.model, "num_classes": int(num_classes),
+    meta = {"model": cfg.model, "num_classes": int(num_classes),
             "scan_layers": bool(scan_layers),
             "compute_dtype": cfg.compute_dtype,
             "num_kv_heads": int(cfg.num_kv_heads),
@@ -158,6 +159,18 @@ def checkpoint_metadata(cfg: Config, num_classes: int,
                                 or cfg.resolve_param_residency(
                                     jax.default_backend())),
             "sync_bucket_mb": float(cfg.sync_bucket_mb)}
+    if params_template is not None:
+        # per-worker params leaf shapes (ISSUE 12 satellite): a
+        # scatter-resident checkpoint's 1/N bucket rows carry no leaf
+        # shapes of their own — recording the template here lets
+        # TEMPLATE-FREE consumers (serve) unpack the consensus straight
+        # from the shard rows instead of refusing resident checkpoints
+        flat = jax.tree_util.tree_flatten_with_path(params_template)[0]
+        meta["params_leaves"] = [
+            [[str(getattr(k, "key", k)) for k in path],
+             [int(d) for d in leaf.shape], str(np.dtype(leaf.dtype))]
+            for path, leaf in flat]
+    return meta
 
 
 @contextmanager
@@ -274,11 +287,21 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         # the snapshot IS the post-event state: membership events at
         # rounds <= its epoch are baked into its roster and must not
         # replay (wall perturbations stay — slow factors persist from
-        # their event round on, exactly as the continued run feels them)
+        # their event round on, exactly as the continued run feels them).
+        # A crash AT the snapshot epoch is baked in too: the recovery
+        # snapshot is built at the crashed round's boundary with the
+        # worker already removed, and the fresh twin re-runs that round
+        # on the post-crash roster.
         schedule = chaos_lib.ChaosSchedule(
             [e for e in schedule.events
-             if e.kind not in ("kill", "join")
+             if e.kind not in ("kill", "join", "crash")
              or e.round > elastic_snapshot.epoch])
+    # ISSUE 12 arming: the crash-rollback machinery (per-round fenced
+    # host snapshot, serial round settlement) and the NaN integrity
+    # screen (a compiled-in sync program input) exist exactly when the
+    # schedule can exercise them — a clean run's round loop is untouched
+    crash_armed = schedule is not None and schedule.has_kind("crash")
+    nan_armed = schedule is not None and schedule.has_kind("nan")
     policy = (chaos_lib.StragglerPolicy(
         cfg.time_limit, cfg.chaos_grace, cfg.chaos_retries,
         cfg.chaos_backoff) if schedule is not None else None)
@@ -335,10 +358,16 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     n_start = n
     pending_departs: list = []   # straggler-protocol departures awaiting
     #                              the next round boundary
+    quarantine_strikes: dict[int, int] = {}   # consecutive quarantined
+    #                              rounds per logical worker (ISSUE 12)
     el: dict[str, Any] = {"enabled": elastic_on, "events": [],
                           "rejected": [], "sync_retries": [],
                           "reshard_ms": [], "rounds_degraded": 0,
-                          "snapshots": []}
+                          "snapshots": [],
+                          # unplanned-failure telemetry (ISSUE 12)
+                          "crashes": 0, "recoveries": 0,
+                          "recovery_source": [], "recovery_ms": [],
+                          "quarantined_rounds": 0}
 
     # --- data ---------------------------------------------------------
     if datasets is None:
@@ -643,7 +672,8 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     if train_kw:
         train_model = build_model_for(cfg, num_classes, **base_kw, **train_kw)
     engine = LocalSGDEngine(model, mesh, cfg, train_model=train_model,
-                            param_specs_fn=param_specs_fn)
+                            param_specs_fn=param_specs_fn,
+                            nan_screen=nan_armed)
     # the engine resolution is per topology (Config.resolve_sync_mode):
     # bucketed reduce-scatter for allreduce, bucketed ppermute gossip for
     # ring/double_ring, legacy per-leaf dense otherwise — surfaced here
@@ -678,7 +708,8 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             async_write=cfg.ckpt_async,
             metadata=checkpoint_metadata(
                 cfg, num_classes, layer_scan_on,
-                param_residency=engine.param_residency))
+                param_residency=engine.param_residency,
+                params_template=engine.params_template))
     start_epoch = 0
     if ckpt_engine is not None and cfg.resume:
         if elastic_snapshot is not None:
@@ -701,7 +732,7 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                                .removesuffix(".msgpack")
                                .rsplit("_", 1)[1])
             past = [e.describe() for e in schedule.events
-                    if e.kind in ("kill", "join")
+                    if e.kind in ("kill", "join", "crash")
                     and e.round < resume_epoch]
             if past:
                 raise ValueError(
@@ -724,9 +755,13 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                     "or kill/join) happened before it was saved; "
                     "restart fresh or resume a pre-change epoch")
         if latest:
+            # buddy rows are derived state: restore strips them from
+            # the template itself (checkpoint._strip_buddy — the one
+            # place that owns the invariant); re-derive + restage after
             state, start_epoch = ckpt_lib.restore_checkpoint(
                 latest, state, params_template=engine.params_template,
                 bucket_bytes=engine.sync_bucket_bytes)
+            state = engine.refresh_buddy(state)
             log.info("resumed from %s at global epoch %d", latest, start_epoch)
 
     # --- probe -> ratios -> initial partition ---------------------------
@@ -883,7 +918,14 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     # writer only does local file I/O.)  Overlap therefore applies
     # single-process only; multi-host keeps the serial data flow
     # (identical results either way).
-    overlap = cfg.overlap_rounds and jax.process_count() == 1
+    # Unplanned-failure arming forces the SERIAL flow (ISSUE 12): a
+    # crash verdict voids the whole round — its metrics must not have
+    # been assembled by a worker thread before the verdict lands — and
+    # the NaN quarantine escalation consumes each round's validity flags
+    # before the next boundary.  Serial vs overlapped is result-identical
+    # anyway; a chaos harness trades the gap for rollback simplicity.
+    overlap = (cfg.overlap_rounds and jax.process_count() == 1
+               and not (crash_armed or nan_armed))
     streaming = cfg.stream_chunk_steps > 0
     # ROADMAP overlap follow-on (a): the pre-dispatch state barrier exists
     # for the 1-core XLA:CPU collective rendezvous (a second in-flight
@@ -1031,6 +1073,7 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         timing["assemble_ms"] = round((time.perf_counter() - t0) * 1e3, 3)
         report_progress(mx, global_epoch, time.perf_counter() - t_dispatch,
                         wids)
+        return mx
 
     executor = (ThreadPoolExecutor(max_workers=1,
                                    thread_name_prefix="round-metrics")
@@ -1049,7 +1092,11 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     # remaining compute), inflating the EMA and halving step caps
     t_done_prev: list = [None]
 
-    def record_walls(ep: int, wall: float, steps_run, timing_: dict):
+    def record_walls(ep: int, wall: float, steps_run, timing_: dict
+                     ) -> list[int]:
+        """Record one round's walls; returns the CRASHED logical ids
+        (non-empty voids the round — the caller rolls back instead of
+        recording anything, ISSUE 12)."""
         timing_["compute_ms"] = round(wall * 1e3, 3)
         # record the measured wall for DELAYED consumption: the EMA
         # blends it in when round ep + 2 is being prepared
@@ -1057,10 +1104,21 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             worker_walls = np.asarray(
                 simulated_round_durations(ep), np.float64)
             if worker_walls.shape != (n,):
-                raise ValueError(
-                    f"simulated_round_durations({ep}) returned shape "
-                    f"{worker_walls.shape}; round {ep}'s membership has "
-                    f"{n} workers")
+                # ELASTIC runs only: a LOGICAL-id-indexed vector
+                # (covering every id ever live) also works — a crash
+                # re-runs its round on the shrunk roster, so one
+                # epoch-keyed callable must serve two membership sizes
+                # (tests index by stable logical ids).  Fixed-membership
+                # runs keep the strict shape error: there a mis-sized
+                # vector is a harness bug, not a roster mismatch.
+                if (elastic_on and worker_walls.ndim == 1 and worker_ids
+                        and len(worker_walls) > max(worker_ids)):
+                    worker_walls = worker_walls[worker_ids]
+                else:
+                    raise ValueError(
+                        f"simulated_round_durations({ep}) returned shape "
+                        f"{worker_walls.shape}; round {ep}'s membership "
+                        f"has {n} workers")
         else:
             # total steps this round = epochs_local x (train + val
             # steps); attribute the wall to train steps proportionally
@@ -1076,8 +1134,14 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             # straggler protocol: overruns past the backoff-extended
             # deadline are tolerated as logged retries; one past the
             # retry budget and the worker departs at the next boundary,
-            # its shard redistributed to the surviving quorum
-            departed, retries = policy.observe(worker_ids, worker_walls)
+            # its shard redistributed to the surviving quorum.  A missed
+            # fence (non-finite wall) is the distinct CRASHED verdict:
+            # the whole round is void — no wall recorded, no straggler
+            # verdicts drawn from it — and the caller rolls back.
+            departed, crashed, retries = policy.observe(worker_ids,
+                                                        worker_walls)
+            if crashed:
+                return crashed
             if retries:
                 el["sync_retries"].extend(retries)
                 for r in retries:
@@ -1091,6 +1155,7 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                 pending_departs.append(chaos_lib.ChaosEvent(
                     kind="depart", round=ep + 1, worker=int(wid)))
         walls_by_round[ep] = (worker_walls, steps_run)
+        return []
 
     def finish_inflight():
         """Deep pipeline: block on the deferred round's completion marker
@@ -1123,7 +1188,8 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             train_parts, val_parts, fixed_classes
         mesh = resize_data_axis(mesh, snap.n_workers)
         engine = LocalSGDEngine(model, mesh, cfg, train_model=train_model,
-                                param_specs_fn=param_specs_fn)
+                                param_specs_fn=param_specs_fn,
+                                nan_screen=nan_armed)
         if snap.params_template is not None:
             # resident bucket rows carry no leaf shapes; the new engine's
             # entry gather and host re-layouts need the per-worker
@@ -1147,6 +1213,163 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         results["sync_engine"]["param_residency"] = engine.param_residency
         results["sync_engine"]["per_worker_state_bytes"] = \
             engine.state_resident_bytes(state)
+
+    def process_quarantine(rnd: int, okv: np.ndarray) -> None:
+        """Turn one round's per-worker sync validity flags into
+        quarantine strikes (ISSUE 12): a quarantined contribution is a
+        logged strike; more than ``--chaos_retries`` CONSECUTIVE strikes
+        escalate to a departure at the next boundary (the worker is
+        producing garbage every round — remove it and redistribute its
+        shard); a clean round resets the count."""
+        for pos, wid in enumerate(worker_ids):
+            if okv[pos] > 0:
+                quarantine_strikes.pop(wid, None)
+                continue
+            k = quarantine_strikes.get(wid, 0) + 1
+            quarantine_strikes[wid] = k
+            el["quarantined_rounds"] += 1
+            log.warning(
+                "elastic: worker %d's round-%d sync contribution was "
+                "quarantined (poisoned/non-finite) — blend renormalized "
+                "over the survivors; strike %d (budget %d)",
+                wid, rnd, k, cfg.chaos_retries)
+            if k > cfg.chaos_retries:
+                quarantine_strikes.pop(wid, None)
+                log.warning(
+                    "elastic: worker %d exhausted the quarantine strike "
+                    "budget — departing at the next round boundary", wid)
+                pending_departs.append(chaos_lib.ChaosEvent(
+                    kind="depart", round=rnd + 1, worker=int(wid)))
+
+    def recover_from_crash(rnd: int, crashed: list[int],
+                           boundary_host) -> None:
+        """Bounded rollback recovery (ISSUE 12 tentpole): round ``rnd``
+        is VOID — worker(s) ``crashed`` missed its fence mid-round.
+        Roll back to the boundary entering ``rnd`` entirely in memory
+        (``boundary_host``, the fenced host snapshot pool), reconstruct
+        the crashed workers' uniquely-held shard-resident spans from
+        their ring buddies (double fault / redundancy off falls back to
+        the newest committed checkpoint — the only path that pays
+        restore I/O), remove them from the membership through the SAME
+        plan -> build_snapshot -> install path a cooperative kill takes
+        (which is what makes the fresh-twin bitwise gate mechanical),
+        and rebuild the round's inputs; the caller then re-runs ``rnd``
+        on the surviving quorum."""
+        nonlocal state, prep, san_warmup
+        t0 = time.perf_counter()
+        el["crashes"] += len(crashed)
+        log.warning(
+            "elastic: worker(s) %s missed the round-%d fence (CRASHED "
+            "mid-round, non-cooperative) — rolling back to the round "
+            "boundary", crashed, rnd)
+        if sanitize and san_counter_ok and san_warmup is not None:
+            # close the steady-state retrace budget before the recovery
+            # window (a sanctioned reshard window, like PR 8's): the new
+            # mesh's round-program compile belongs to the recovery, but
+            # anything traced during the steady rounds before it is
+            # still a bug
+            counts = compile_event_counts()
+            d_tr = counts["traces"] - san_warmup["traces"]
+            d_co = counts["compiles"] - san_warmup["compiles"]
+            if d_tr or d_co:
+                san["retrace_count"] += d_tr
+                san["recompile_count"] += d_co
+                raise RuntimeError(
+                    f"sanitizer: retrace budget exceeded before the "
+                    f"round-{rnd} crash recovery — post-warmup rounds "
+                    f"added {d_tr} jaxpr trace(s) and {d_co} backend "
+                    "compile(s)")
+            san_warmup = None   # next completed round re-baselines
+        # the rollback discards everything the voided round produced:
+        # fold the walls recorded through rnd-1 into the EMA (the
+        # snapshot must carry the final heterogeneity estimate, exactly
+        # like a membership boundary), then clear the straggler /
+        # quarantine ledgers — the fresh twin starts with empty ones
+        consume_walls(upto=rnd)
+        walls_by_round.clear()
+        next_wall_box[0] = rnd
+        if policy is not None:
+            policy.reset()
+        pending_departs.clear()
+        quarantine_strikes.clear()
+        positions = [worker_ids.index(c) for c in crashed]
+        host_state = boundary_host
+        uniquely_held = (engine.resident_on
+                         or (engine.round_opt_on
+                             and engine.opt_placement == "sharded"))
+        opt_pl = engine.opt_placement if engine.round_opt_on else None
+        try:
+            host_state = elastic_lib.restore_crashed_rows(
+                host_state, positions,
+                params_template=engine.params_template,
+                sync_bucket_bytes=engine.sync_bucket_bytes,
+                round_opt_placement=opt_pl)
+            source = "buddy" if uniquely_held else "snapshot"
+        except ValueError as e:
+            # double-fault ladder: worker AND buddy lost, or redundancy
+            # off — the spans exist nowhere in memory.  Degrade to the
+            # newest committed checkpoint, logged and counted.
+            log.warning(
+                "elastic: in-memory buddy recovery unavailable (%s) — "
+                "degrading to the newest committed checkpoint", e)
+            if ckpt_engine is None:
+                raise RuntimeError(
+                    f"crash of worker(s) {crashed} is unrecoverable: "
+                    f"{e}; no --checkpoint_dir is configured to degrade "
+                    "to") from e
+            ckpt_engine.wait()
+            latest = ckpt_engine.latest_checkpoint()
+            if latest is None:
+                raise RuntimeError(
+                    f"crash of worker(s) {crashed} is unrecoverable: "
+                    f"{e}; no committed checkpoint exists yet") from e
+            restored, ck_epoch = ckpt_lib.restore_checkpoint(
+                latest, state, params_template=engine.params_template,
+                bucket_bytes=engine.sync_bucket_bytes)
+            host_state = elastic_lib.host_state_snapshot(
+                engine.checkpoint_fence(restored))
+            source = "checkpoint"
+            if ck_epoch < rnd:
+                log.warning(
+                    "elastic: checkpoint fallback rewound %d round(s) of "
+                    "consensus progress (checkpoint epoch %d < crash "
+                    "round %d) — the run continues at round %d on the "
+                    "restored state", rnd - ck_epoch, ck_epoch, rnd, rnd)
+        events = [chaos_lib.ChaosEvent(kind="crash", round=rnd,
+                                       worker=int(c)) for c in crashed]
+        change = plan.apply(events)
+        if change.rejected or not change.applied:
+            el["rejected"].extend(change.rejected)
+            raise RuntimeError(
+                f"crash of worker(s) {crashed} cannot be applied to the "
+                f"membership {worker_ids} (quorum floor "
+                f"{cfg.elastic_min_workers}): {change.rejected} — a "
+                "crashed worker is gone regardless, so the run cannot "
+                "continue")
+        snap = elastic_lib.build_snapshot(
+            epoch=rnd, change=change, old_state=host_state,
+            sec_per_batch=sec_per_batch, seed=cfg.seed,
+            num_classes=num_classes, trainset_len=len(trainset),
+            valset_len=len(valset), proportionality=cfg.proportionality,
+            data_mode=cfg.data_mode, fixed_ratio=cfg.fixed_ratio,
+            rng=rng, trainset_labels=trainset.labels,
+            valset_labels=valset.labels, next_worker_id=plan.next_id,
+            n_round0=n_round0,
+            round_opt_placement=opt_pl,
+            sync_bucket_bytes=engine.sync_bucket_bytes,
+            params_template=engine.params_template)
+        el["snapshots"].append(elastic_lib.snapshot_copy(snap))
+        install_from_snapshot(snap)
+        el["events"].extend(change.applied)
+        el["recoveries"] += 1
+        el["recovery_source"].append(source)
+        recovery_ms = round((time.perf_counter() - t0) * 1e3, 3)
+        el["recovery_ms"].append(recovery_ms)
+        log.info(
+            "elastic: round %d crash recovery via %s -> %d worker(s) %s; "
+            "stall %.1f ms (round re-runs on the surviving quorum)",
+            rnd, source, n, worker_ids, recovery_ms)
+        prep = make_prep(train_parts, val_parts)
 
     def membership_boundary(rnd: int) -> None:
         """Resolve + apply membership events at the boundary entering
@@ -1254,104 +1477,169 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                 membership_boundary(global_epoch)
                 if n < n_start:
                     el["rounds_degraded"] += 1
-            results["step_caps"].append(list(prep["caps"]))
-            results["shard_sizes"].append(list(prep["sizes"]))
-            # zero-filled checkpoint walls (sync_ms convention: the schema
-            # is identical every round; save rounds overwrite).  The
-            # background writer fills ckpt_write_ms when its write lands —
-            # always before results return (ckpt_engine.wait in finally).
-            timing: dict[str, Any] = {"ckpt_snapshot_ms": 0.0,
-                                      "ckpt_write_ms": 0.0}
-            results["round_timings"].append(timing)
-            t_disp = time.perf_counter()
-            if t_ready is not None:
-                # host time the device sat idle between the previous round
-                # finishing and this round's dispatch — the round gap the
-                # overlap exists to close (bench.py round_gap entry)
-                results["round_timings"][-2]["gap_ms"] = round(
-                    (t_disp - t_ready) * 1e3, 3)
-            # sanitizer donation probe: the packed round program donates
-            # its whole TrainState input — hold the pre-dispatch buffer
-            # refs so the post-wait check can assert XLA actually deleted
-            # them (the streamed path donates only the inner chunk carry,
-            # with lr_epoch deliberately read eagerly, so it is exempt)
-            donated_leaves = (
-                [l for l in jax.tree_util.tree_leaves(state)
-                 if isinstance(l, jax.Array)]
-                if sanitize and not streaming else None)
-            with _round_guard(san):
-                if streaming:
-                    state, handle = engine.round_streamed_start(
-                        state, *prep["inputs"])
-                else:
-                    state, handle = engine.round_start(
-                        state, *prep["inputs"])
-            timing["stage_ms"] = round(
-                (time.perf_counter() - t_disp) * 1e3, 3)
-            if engine.last_sync_stats:
-                # static per-round sync telemetry (bytes on the wire,
-                # mode); the measured collective wall joins after
-                # round_wait when a standalone sync program ran
-                timing.update(engine.last_sync_stats)
-            cur_steps_run = prep["steps_run"]
-            if overlap:
-                pending.append(executor.submit(
-                    metrics_job, handle, global_epoch, t_disp, timing,
-                    list(worker_ids)))
-            ckpt_due = bool(cfg.checkpoint_dir and cfg.checkpoint_every
-                            and (global_epoch + 1) % cfg.checkpoint_every
-                            == 0)
-            last_round = global_epoch + 1 >= cfg.epochs_global
-            defer = deep_pipeline and not ckpt_due and not last_round
-            # settle the PREVIOUS deferred round first in either case: its
-            # wall must be on record before prepare_next runs, so the
-            # delayed-EMA repartition consumes the same wall set as the
-            # serial flow
-            finish_inflight()
-            if defer:
-                # two rounds in flight: leave THIS round computing
-                inflight.append((global_epoch,
-                                 engine.round_done_marker(handle),
-                                 t_disp, timing, cur_steps_run))
-                t_ready = None  # device not idle between rounds here
-            if overlap and not last_round:
-                t0 = time.perf_counter()
-                prep = prepare_next(global_epoch, cur_steps_run)
-                timing["prep_ms"] = round(
-                    (time.perf_counter() - t0) * 1e3, 3)
-            if not defer:
+            # crash-recovery retry loop (ISSUE 12): a round whose fence a
+            # worker misses is VOID — roll back to the boundary snapshot
+            # taken right here and re-run the round on the surviving
+            # quorum.  One iteration is the entire pre-ISSUE-12 body;
+            # re-iteration only ever follows a crash verdict (each one
+            # removes at least one worker, so the loop terminates).
+            while True:
+                boundary_host = None
+                if crash_armed:
+                    # the fenced host snapshot pool: the in-memory
+                    # rollback target for a crash during THIS round (the
+                    # PR 5/8 staging machinery — a copy-not-view host
+                    # snapshot, no checkpoint I/O)
+                    state = engine.checkpoint_fence(state)
+                    boundary_host = elastic_lib.host_state_snapshot(state)
+                results["step_caps"].append(list(prep["caps"]))
+                results["shard_sizes"].append(list(prep["sizes"]))
+                # zero-filled checkpoint walls (sync_ms convention: the
+                # schema is identical every round; save rounds
+                # overwrite).  The background writer fills ckpt_write_ms
+                # when its write lands — always before results return
+                # (ckpt_engine.wait in finally).
+                timing: dict[str, Any] = {"ckpt_snapshot_ms": 0.0,
+                                          "ckpt_write_ms": 0.0}
+                results["round_timings"].append(timing)
+                t_disp = time.perf_counter()
+                if t_ready is not None:
+                    # host time the device sat idle between the previous
+                    # round finishing and this round's dispatch — the
+                    # round gap the overlap exists to close (bench.py
+                    # round_gap entry)
+                    results["round_timings"][-2]["gap_ms"] = round(
+                        (t_disp - t_ready) * 1e3, 3)
+                poison = None
+                if nan_armed:
+                    # stage this round's per-worker poison flags (nan@R
+                    # faults) — an EXPLICIT put, transfer-guard-safe
+                    targets = schedule.nan_targets(global_epoch,
+                                                   worker_ids)
+                    poison = engine.stage_poison(np.array(
+                        [wid in targets for wid in worker_ids],
+                        np.bool_))
+                # sanitizer donation probe: the packed round program
+                # donates its whole TrainState input — hold the
+                # pre-dispatch buffer refs so the post-wait check can
+                # assert XLA actually deleted them (the streamed path
+                # donates only the inner chunk carry, with lr_epoch
+                # deliberately read eagerly, so it is exempt; the buddy
+                # rows are NOT a program input — round_start drops them
+                # and the sync program writes the fresh copy — so they
+                # are excluded from the donation contract)
+                donated_leaves = (
+                    [l for l in jax.tree_util.tree_leaves(
+                        state.replace(buddy=None))
+                     if isinstance(l, jax.Array)]
+                    if sanitize and not streaming else None)
                 with _round_guard(san):
-                    state = engine.round_wait(state)
+                    if streaming:
+                        state, handle = engine.round_streamed_start(
+                            state, *prep["inputs"], poison=poison)
+                    else:
+                        state, handle = engine.round_start(
+                            state, *prep["inputs"], poison=poison)
+                timing["stage_ms"] = round(
+                    (time.perf_counter() - t_disp) * 1e3, 3)
                 if engine.last_sync_stats:
+                    # static per-round sync telemetry (bytes on the wire,
+                    # mode); the measured collective wall joins after
+                    # round_wait when a standalone sync program ran
                     timing.update(engine.last_sync_stats)
-                t_ready = time.perf_counter()
-                # the barrier round right after a deferred one also started
-                # computing only when its predecessor finished (same
-                # double-count hazard finish_inflight corrects)
-                start = t_disp if t_done_prev[0] is None \
-                    else max(t_disp, t_done_prev[0])
-                t_done_prev[0] = t_ready
-                record_walls(global_epoch, t_ready - start,
-                             cur_steps_run, timing)
-                if donated_leaves is not None:
-                    # donation hygiene at runtime (graftlint R4's dynamic
-                    # twin): every leaf handed to the round program must
-                    # be gone now — a surviving buffer means XLA declined
-                    # the donation (sharding/layout mismatch) and the
-                    # round silently ran at double state memory
-                    fails = [i for i, l in enumerate(donated_leaves)
-                             if not l.is_deleted()]
-                    if fails:
-                        san["donation_failures"] += len(fails)
-                        raise RuntimeError(
-                            f"sanitizer: {len(fails)} of "
-                            f"{len(donated_leaves)} donated round-state "
-                            f"buffers survived round {global_epoch} — "
-                            "donation was declined (check in/out "
-                            "sharding match of the round program)")
+                cur_steps_run = prep["steps_run"]
+                if overlap:
+                    pending.append(executor.submit(
+                        metrics_job, handle, global_epoch, t_disp, timing,
+                        list(worker_ids)))
+                ckpt_due = bool(cfg.checkpoint_dir and cfg.checkpoint_every
+                                and (global_epoch + 1)
+                                % cfg.checkpoint_every == 0)
+                last_round = global_epoch + 1 >= cfg.epochs_global
+                defer = deep_pipeline and not ckpt_due and not last_round
+                # settle the PREVIOUS deferred round first in either
+                # case: its wall must be on record before prepare_next
+                # runs, so the delayed-EMA repartition consumes the same
+                # wall set as the serial flow
+                finish_inflight()
+                if defer:
+                    # two rounds in flight: leave THIS round computing
+                    inflight.append((global_epoch,
+                                     engine.round_done_marker(handle),
+                                     t_disp, timing, cur_steps_run))
+                    t_ready = None  # device not idle between rounds here
+                if overlap and not last_round:
+                    t0 = time.perf_counter()
+                    prep = prepare_next(global_epoch, cur_steps_run)
+                    timing["prep_ms"] = round(
+                        (time.perf_counter() - t0) * 1e3, 3)
+                crashed: list[int] = []
+                if not defer:
+                    with _round_guard(san):
+                        state = engine.round_wait(state)
+                    if engine.last_sync_stats:
+                        timing.update(engine.last_sync_stats)
+                    t_ready = time.perf_counter()
+                    # the barrier round right after a deferred one also
+                    # started computing only when its predecessor
+                    # finished (same double-count hazard finish_inflight
+                    # corrects)
+                    start = t_disp if t_done_prev[0] is None \
+                        else max(t_disp, t_done_prev[0])
+                    t_done_prev[0] = t_ready
+                    crashed = record_walls(global_epoch, t_ready - start,
+                                           cur_steps_run, timing)
+                    if donated_leaves is not None:
+                        # donation hygiene at runtime (graftlint R4's
+                        # dynamic twin): every leaf handed to the round
+                        # program must be gone now — a surviving buffer
+                        # means XLA declined the donation (sharding/
+                        # layout mismatch) and the round silently ran at
+                        # double state memory
+                        fails = [i for i, l in enumerate(donated_leaves)
+                                 if not l.is_deleted()]
+                        if fails:
+                            san["donation_failures"] += len(fails)
+                            raise RuntimeError(
+                                f"sanitizer: {len(fails)} of "
+                                f"{len(donated_leaves)} donated "
+                                "round-state buffers survived round "
+                                f"{global_epoch} — donation was declined "
+                                "(check in/out sharding match of the "
+                                "round program)")
+                if not crashed:
+                    break
+                if boundary_host is None:
+                    # a non-finite wall without crash faults armed (a
+                    # caller-injected inf/NaN simulated wall): the
+                    # rollback snapshot pool is off, so recovery is
+                    # impossible — fail with the real reason instead of
+                    # an UnboundLocalError deep in the recovery path
+                    raise RuntimeError(
+                        f"worker(s) {crashed} reported a non-finite "
+                        f"round-{global_epoch} wall but no crash fault "
+                        "is armed (--chaos has no crash events), so no "
+                        "rollback boundary snapshot exists — fix the "
+                        "wall injection or script the crash")
+                # the round is VOID: discard everything it appended (its
+                # metrics were never assembled — crash arming forces the
+                # serial flow, and metrics_job runs only after this
+                # loop), restore the boundary, and re-run the round.
+                # t_ready resets too: round R-1's gap_ms was written
+                # correctly by this voided attempt's dispatch, and the
+                # re-run must not overwrite it with the voided round's
+                # compute + the recovery stall (reported in recovery_ms)
+                results["step_caps"].pop()
+                results["shard_sizes"].pop()
+                results["round_timings"].pop()
+                t_ready = None
+                recover_from_crash(global_epoch, crashed, boundary_host)
             if not overlap:
-                metrics_job(handle, global_epoch, t_disp, timing,
-                            list(worker_ids))
+                mx = metrics_job(handle, global_epoch, t_disp, timing,
+                                 list(worker_ids))
+                if nan_armed and mx is not None and "sync_ok" in mx:
+                    process_quarantine(global_epoch,
+                                       np.asarray(mx["sync_ok"]))
                 if not last_round:
                     t0 = time.perf_counter()
                     prep = prepare_next(global_epoch, cur_steps_run)
@@ -1380,6 +1668,9 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                 # the engine fence + host snapshot then read the buffers
                 # before donation can invalidate them, and the round loop
                 # resumes while the background thread serializes + commits.
+                # buddy rows (ISSUE 12) are derived state; the save
+                # itself strips them (checkpoint._strip_buddy), so the
+                # checkpoint layout is independent of the redundancy flag
                 ckpt_engine.save(engine.checkpoint_fence(state),
                                  global_epoch + 1, timing=timing)
             if sanitize and san_warmup is None:

@@ -163,14 +163,17 @@ class MembershipPlan:
         next_id = self._next_id
         # departures resolve before joins at the same boundary: a kill
         # frees the device position its worker held, so a simultaneous
-        # kill+join on a full mesh is a swap, not a capacity rejection
-        order = {"kill": 0, "depart": 0}
+        # kill+join on a full mesh is a swap, not a capacity rejection.
+        # "crash" (ISSUE 12) is a departure too — the rollback recovery
+        # routes the crashed worker's removal through this same plan so
+        # the id allocator, quorum floor, and snapshot path are shared.
+        order = {"kill": 0, "depart": 0, "crash": 0}
         events = sorted(events, key=lambda e: order.get(
             e.kind if hasattr(e, "kind") else e["kind"], 1))
         for e in events:
             kind = e.kind if hasattr(e, "kind") else e["kind"]
             desc = e.describe() if hasattr(e, "describe") else dict(e)
-            if kind in ("kill", "depart"):
+            if kind in ("kill", "depart", "crash"):
                 target = (resolve(e, ids) if resolve is not None
                           and getattr(e, "worker", None) is None
                           else getattr(e, "worker", None))
@@ -215,10 +218,28 @@ def host_state_snapshot(state):
     host numpy — the caller fences first (``engine.checkpoint_fence`` /
     ``round_wait`` already did at a round boundary).  Arrays are copies,
     never views: once this returns, the old engine's buffers may be
-    donated or freed."""
-    return jax.tree_util.tree_map(
-        lambda x: np.array(x, copy=True) if isinstance(x, jax.Array)
-        else np.asarray(x), state)
+    donated or freed.
+
+    SINGLE-shard arrays are read through a device-side copy first: on
+    XLA:CPU ``np.array(x)`` of a one-shard array returns a zero-copy
+    host view that jax CACHES on the Array, which pins the buffer and
+    silently DECLINES any later donation of it (the read-side twin of
+    the ``checkpoint._reshard_leaf`` / ``engine._put`` zero-copy hazard
+    — found by the sanitizer's donation probe when the ISSUE 12 crash
+    rollback started snapshotting states that are subsequently donated
+    back into the round program).  Multi-shard arrays assemble a fresh
+    host buffer, so only the one-shard case needs the detour."""
+    import jax.numpy as jnp
+
+    def fetch(x):
+        if not isinstance(x, jax.Array):
+            return np.asarray(x)
+        if len(x.sharding.device_set) == 1:
+            # read the COPY's buffer; the original stays donation-clean
+            x = jax.block_until_ready(jnp.copy(x))
+        return np.array(x, copy=True)
+
+    return jax.tree_util.tree_map(fetch, state)
 
 
 def reshard_state(host_state, kept_positions: list[int],
@@ -263,6 +284,15 @@ def reshard_state(host_state, kept_positions: list[int],
     >= 2): the consensus tree is materialized and tiled."""
     if not kept_positions:
         raise ValueError("membership change left no surviving workers")
+    # Buddy rows (ISSUE 12) are DERIVED state — ring-rolled copies of
+    # the shard-resident layouts for the OLD worker count.  A membership
+    # change re-tiles those layouts, so the buddy copy is dropped here
+    # and re-derived against the new tiling at the end (the device hop
+    # refreshes it every round anyway; this keeps restaged states
+    # complete for a crash landing before the first post-change sync).
+    had_buddy = host_state.buddy is not None
+    if had_buddy:
+        host_state = host_state.replace(buddy=None)
     resident = host_state.params_resident
     if resident is not None:
         if params_template is None or sync_bucket_bytes is None:
@@ -316,7 +346,9 @@ def reshard_state(host_state, kept_positions: list[int],
     base = jax.tree_util.tree_map(take, host_state)
     k = len(joiner_ids)
     if not k:
-        return base.replace(round_opt=round_opt, params_resident=resident)
+        out = base.replace(round_opt=round_opt, params_resident=resident)
+        return _rebuild_buddy(out, had_buddy, params_template,
+                              sync_bucket_bytes, round_opt_placement)
     clone = lambda x: np.concatenate(
         [x, np.repeat(x[:1], k, axis=0)], axis=0)
     out = jax.tree_util.tree_map(clone, base)
@@ -335,7 +367,120 @@ def reshard_state(host_state, kept_positions: list[int],
             y[nk:] = 0
             return y
         zero_res = jax.tree_util.tree_map(z, out.sync_residual)
-    return out.replace(rng=rng, sync_residual=zero_res)
+    out = out.replace(rng=rng, sync_residual=zero_res)
+    return _rebuild_buddy(out, had_buddy, params_template,
+                          sync_bucket_bytes, round_opt_placement)
+
+
+def _rebuild_buddy(out, had_buddy: bool, params_template,
+                   sync_bucket_bytes, round_opt_placement):
+    """Re-derive the ISSUE 12 buddy rows against the post-change tiling
+    (no-op when the source state carried none, or when nothing stays
+    shard-resident on the new worker count)."""
+    if not had_buddy:
+        return out
+    from . import comms
+    n_new = None
+    for comp in (out.params_resident, out.round_opt):
+        if comp is not None:
+            n_new = int(np.shape(next(iter(jax.tree_util.tree_leaves(
+                comp))))[0])
+            break
+    sharded_opt = (out.round_opt is not None
+                   and round_opt_placement == "sharded")
+    if n_new is None or n_new < 2 or not (
+            out.params_resident is not None or sharded_opt):
+        return out
+    if params_template is None:
+        # gradients-mode tracker states carry full params: the
+        # per-worker template the bucket plan needs is in hand
+        params_template = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(tuple(np.shape(x)[1:]),
+                                           np.asarray(x).dtype),
+            out.params)
+    return out.replace(buddy=comms.derive_buddy(
+        params_template, n_new,
+        bucket_bytes=int(sync_bucket_bytes),
+        params_resident=out.params_resident,
+        round_opt=out.round_opt if sharded_opt else None,
+        residual=(out.sync_residual
+                  if out.params_resident is not None else None),
+        opt_placement=round_opt_placement or "sharded"))
+
+
+def restore_crashed_rows(host_state, lost_positions: list[int], *,
+                         params_template=None,
+                         sync_bucket_bytes: int | None = None,
+                         round_opt_placement: str | None = None):
+    """Patch a boundary host snapshot for CRASHED worker positions
+    (ISSUE 12 buddy recovery).
+
+    The crashed workers' uniquely-held shard-resident rows — their
+    ``params_resident`` rows, their sharded ``round_opt`` moment rows —
+    are reconstructed from the ring-successor's buddy copy
+    (``comms.buddy_restore_rows``), and the pending stage-2 EF span is
+    folded into the holder's residual; replicated ``round_opt`` rows
+    are repaired from any surviving row (N identical copies).  The
+    crashed workers' PER-WORKER rows (opt state, RNG, BN stats, their
+    own residual rows) need no reconstruction: the subsequent
+    ``reshard_state`` drops them exactly as a cooperative kill would.
+    Raises on a double fault (crashed worker AND its buddy) or when the
+    snapshot carries no buddy rows — callers fall back to the newest
+    committed checkpoint."""
+    lost = sorted(set(int(p) for p in lost_positions))
+    resident = host_state.params_resident
+    round_opt = host_state.round_opt
+    sharded_opt = (round_opt is not None
+                   and round_opt_placement == "sharded")
+    if resident is None and round_opt is None:
+        return host_state   # nothing uniquely held: the rollback
+        #                     snapshot alone recovers the state
+    if round_opt is not None and not sharded_opt:
+        # replicated tracker rows are N identical copies; repair the
+        # crashed rows from a surviving one so no dead row is ever read
+        n = next(int(np.shape(a)[0]) for a in
+                 jax.tree_util.tree_leaves(round_opt))
+        survivor = next(p for p in range(n) if p not in lost)
+        fixed = jax.tree_util.tree_map(
+            lambda a: _overwrite_rows(np.asarray(a), lost, survivor),
+            round_opt)
+        host_state = host_state.replace(round_opt=fixed)
+        round_opt = None if resident is None else round_opt
+    if resident is None and not sharded_opt:
+        return host_state
+    if host_state.buddy is None:
+        raise ValueError(
+            "state carries shard-resident rows but no buddy copy "
+            "(--shard_redundancy off?) — the crashed spans exist "
+            "nowhere else in memory")
+    if params_template is None or sync_bucket_bytes is None:
+        raise ValueError(
+            "restore_crashed_rows needs params_template and "
+            "sync_bucket_bytes to address the bucket spans")
+    from . import comms
+    parts: dict = {}
+    if resident is not None:
+        parts["params_resident"] = resident
+        if host_state.sync_residual is not None:
+            parts["residual"] = host_state.sync_residual
+    if sharded_opt:
+        parts["round_opt"] = round_opt
+    patched = comms.buddy_restore_rows(
+        parts, host_state.buddy, lost, params_template,
+        bucket_bytes=int(sync_bucket_bytes))
+    return host_state.replace(
+        params_resident=patched.get("params_resident",
+                                    host_state.params_resident),
+        round_opt=patched.get("round_opt", host_state.round_opt),
+        sync_residual=patched.get("residual", host_state.sync_residual))
+
+
+def _overwrite_rows(arr: np.ndarray, rows: list[int],
+                    source: int) -> np.ndarray:
+    out = arr.copy()
+    for r in rows:
+        out[r] = arr[source]
+    return out
 
 
 def build_snapshot(*, epoch: int, change: MembershipChange, old_state,

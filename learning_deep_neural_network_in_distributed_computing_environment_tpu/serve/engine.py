@@ -94,6 +94,30 @@ def _parse_params_key(key: str) -> Optional[tuple[str, ...]]:
     return tuple(_KEY_SEG.findall(key[len(".params"):]))
 
 
+def _verified_shard_leaves(path: str, manifest: dict):
+    """Iterate a sharded checkpoint's ``(key, piece_list)`` leaf entries,
+    one shard FILE at a time, with the manifest's size + crc32 checks
+    applied before any payload is decoded — the shared streaming core of
+    ``load_params_row0`` and ``load_params_resident`` (at most one
+    shard's payload is resident at a time; a missing file is skipped,
+    corruption raises)."""
+    from flax import serialization
+    for fname, info in manifest["shards"].items():
+        fp = os.path.join(path, fname)
+        if not os.path.isfile(fp):
+            continue
+        with open(fp, "rb") as f:
+            raw = f.read()
+        if (len(raw) != int(info["bytes"])
+                or zlib.crc32(raw) != int(info["crc32"])):
+            raise ValueError(f"checkpoint shard {fp} is corrupt (size/crc "
+                             "mismatch vs manifest)")
+        payload = serialization.msgpack_restore(raw)
+        del raw
+        yield from payload["leaves"].items()
+        del payload
+
+
 def load_params_row0(path: str, sharding=None) -> dict:
     """Stream a sharded checkpoint's ``params`` leaves to device.
 
@@ -115,39 +139,26 @@ def load_params_row0(path: str, sharding=None) -> dict:
         raise ValueError(f"checkpoint {path} has no params leaves")
     acc: dict[tuple, tuple[np.ndarray, int]] = {}
     device: dict[tuple, jax.Array] = {}
-    for fname, info in manifest["shards"].items():
-        fp = os.path.join(path, fname)
-        if not os.path.isfile(fp):
+    for key, plist in _verified_shard_leaves(path, manifest):
+        segs = _parse_params_key(key)
+        if segs is None or segs not in want or segs in device:
             continue
-        with open(fp, "rb") as f:
-            raw = f.read()
-        if (len(raw) != int(info["bytes"])
-                or zlib.crc32(raw) != int(info["crc32"])):
-            raise ValueError(f"checkpoint shard {fp} is corrupt (size/crc "
-                             "mismatch vs manifest)")
-        from flax import serialization
-        payload = serialization.msgpack_restore(raw)
-        for key, plist in payload["leaves"].items():
-            segs = _parse_params_key(key)
-            if segs is None or segs not in want or segs in device:
-                continue
-            shape = tuple(want[segs]["shape"])
-            for index, arr in plist:
-                lo, hi = index[0]
-                if not lo <= 0 < hi:
-                    continue   # piece does not cover the worker-0 row
-                if segs not in acc:
-                    acc[segs] = (np.empty(shape[1:], arr.dtype), 0)
-                buf, filled = acc[segs]
-                buf[tuple(slice(a, b) for a, b in index[1:])] = arr[0]
-                acc[segs] = (buf, filled + int(arr[0].size))
-            if segs in acc and acc[segs][1] == int(
-                    np.prod(shape[1:], dtype=np.int64)):
-                buf = acc.pop(segs)[0]
-                device[segs] = (jax.device_put(buf, sharding)
-                                if sharding is not None
-                                else jax.device_put(buf))
-        del payload, raw
+        shape = tuple(want[segs]["shape"])
+        for index, arr in plist:
+            lo, hi = index[0]
+            if not lo <= 0 < hi:
+                continue   # piece does not cover the worker-0 row
+            if segs not in acc:
+                acc[segs] = (np.empty(shape[1:], arr.dtype), 0)
+            buf, filled = acc[segs]
+            buf[tuple(slice(a, b) for a, b in index[1:])] = arr[0]
+            acc[segs] = (buf, filled + int(arr[0].size))
+        if segs in acc and acc[segs][1] == int(
+                np.prod(shape[1:], dtype=np.int64)):
+            buf = acc.pop(segs)[0]
+            device[segs] = (jax.device_put(buf, sharding)
+                            if sharding is not None
+                            else jax.device_put(buf))
     missing = [k for k in want if k not in device]
     if missing:
         raise ValueError(
@@ -161,6 +172,78 @@ def load_params_row0(path: str, sharding=None) -> dict:
             node = node.setdefault(s, {})
         node[segs[-1]] = arr
     return out
+
+
+def load_params_resident(path: str, meta: dict, sharding=None) -> dict:
+    """Stream a SCATTER-RESIDENT sharded checkpoint's params to device
+    (ISSUE 12 satellite: PR 11 left a hard refusal here).
+
+    A resident checkpoint stores the consensus params as 1/N bucket
+    shard rows (``.params_resident['bNNNN']`` leaves, ``[N, padded/N]``
+    each) instead of ``.params`` leaves — there is no worker-0 row to
+    stream.  But the manifest METADATA records the per-worker leaf
+    template (``params_leaves``) and the bucket size, so the consensus
+    unpacks template-free: accumulate each bucket's full row matrix
+    across shard files (crc-verified), concatenate the rows
+    (``comms.resident_to_tree`` — the host twin of the round-entry
+    gather, bit-exact), and ``device_put`` per leaf.  The worker rows
+    are 1/N each, so peak host residency stays one bucket matrix + the
+    in-flight leaves."""
+    tmpl_rows = meta.get("params_leaves")
+    if not tmpl_rows:
+        raise ValueError(
+            f"checkpoint {path} stores scatter-resident params but its "
+            "metadata carries no params_leaves template (saved by a "
+            "pre-ISSUE-12 engine) — restore+re-save with the current "
+            "engine, or with --param_residency replicated")
+    template: dict = {}
+    for segs, shape, dtype in tmpl_rows:
+        node = template
+        for s in segs[:-1]:
+            node = node.setdefault(s, {})
+        node[segs[-1]] = jax.ShapeDtypeStruct(tuple(shape),
+                                              np.dtype(dtype))
+    manifest = ckpt_lib._read_manifest(path)
+    if not manifest:
+        raise FileNotFoundError(f"no committed manifest under {path}")
+    want = {key: info for key, info in manifest["leaves"].items()
+            if key.startswith(".params_resident[")}
+    if not want:
+        raise ValueError(
+            f"checkpoint {path} claims resident params but has no "
+            ".params_resident leaves")
+    acc: dict[str, tuple[np.ndarray, int]] = {}
+    for key, plist in _verified_shard_leaves(path, manifest):
+        if key not in want:
+            continue
+        shape = tuple(want[key]["shape"])
+        for index, arr in plist:
+            if key not in acc:
+                acc[key] = (np.empty(shape, arr.dtype), 0)
+            buf, filled = acc[key]
+            buf[tuple(slice(a, b) for a, b in index)] = arr
+            acc[key] = (buf, filled + int(arr.size))
+    resident: dict = {}
+    for key, (buf, filled) in acc.items():
+        if filled != int(np.prod(buf.shape, dtype=np.int64)):
+            raise ValueError(
+                f"checkpoint {path}: resident bucket {key} is missing "
+                "shard coverage — multi-host checkpoints need a shared "
+                "filesystem")
+        resident[_KEY_SEG.findall(key[len(".params_resident"):])[0]] = buf
+    missing = [k for k in want if k not in acc]
+    if missing:
+        raise ValueError(
+            f"checkpoint {path} is missing resident buckets "
+            f"{missing[:3]}...")
+    from .. import comms
+    bucket_bytes = max(1, int(float(meta.get("sync_bucket_mb", 4.0))
+                              * (1 << 20)))
+    tree = comms.resident_to_tree(resident, template,
+                                  bucket_bytes=bucket_bytes)
+    return jax.tree_util.tree_map(
+        lambda x: (jax.device_put(x, sharding) if sharding is not None
+                   else jax.device_put(x)), tree)
 
 
 def manifest_num_classes(path: str) -> Optional[int]:
@@ -283,17 +366,7 @@ class ServeEngine:
                     "loads the sharded (format 2) layout — re-save with "
                     "the CheckpointEngine")
         meta = ckpt_lib.manifest_metadata(path)
-        if meta.get("param_residency") == "resident":
-            # ISSUE 11: a scatter-resident checkpoint stores params as
-            # 1/N bucket-shard rows (no .params leaves to stream row 0
-            # of); the training restore path re-lays them out against
-            # its engine template, but serving is template-free
-            raise ValueError(
-                f"checkpoint {path} stores scatter-resident params "
-                "(--param_residency resident); serve needs the "
-                "replicated layout — restore+re-save with "
-                "--param_residency replicated, or point serve at a "
-                "replicated-era epoch")
+        resident = meta.get("param_residency") == "resident"
         if model is None:
             if not meta:
                 raise ValueError(
@@ -304,10 +377,19 @@ class ServeEngine:
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             sharding = NamedSharding(mesh, P())
-        params = load_params_row0(path, sharding)
-        log.info("serve: restored %s params from %s onto %s",
+        if resident:
+            # ISSUE 12 satellite: a scatter-resident checkpoint stores
+            # the consensus as 1/N bucket shard rows — unpack them
+            # against the manifest-metadata leaf template (bit-exact,
+            # the host twin of the round-entry gather) instead of the
+            # PR 11 refusal
+            params = load_params_resident(path, meta, sharding)
+        else:
+            params = load_params_row0(path, sharding)
+        log.info("serve: restored %s params from %s (%s layout) onto %s",
                  meta.get("model") if meta else type(model).__name__,
-                 path, "mesh" if mesh is not None else "default device")
+                 path, "resident" if resident else "replicated",
+                 "mesh" if mesh is not None else "default device")
         return cls(model, params, mesh=mesh, **engine_kw)
 
     # -- page math -----------------------------------------------------

@@ -103,6 +103,21 @@ class TrainState(struct.PyTreeNode):
     # resident state.  Layouts interconvert exactly
     # (comms.resident_from_tree / resident_to_tree / resident_relayout).
     params_resident: PyTree = None
+    # Buddy-redundant resident shards (ISSUE 12; ``--shard_redundancy``
+    # buddy/auto with something shard-resident; None otherwise).  One
+    # dict per sync bucket whose row w holds worker (w-1) % N's
+    # shard-resident spans — the resident params row ("params"), the
+    # sharded round-opt moments ("mu"/"nu"), and under EF the owned
+    # residual span ("res") — delivered by one extra ppermute fused onto
+    # the sync program at scatter exit (comms.sharded_opt_sync).  Every
+    # 1/N span therefore lives on exactly TWO workers, and an abrupt
+    # mid-round worker loss is recoverable in memory from the buddy copy
+    # (driver rollback recovery).  Derivable state: ring-rolled copies of
+    # the rows above — STRIPPED from checkpoints and re-derived on
+    # restore/reshard (comms.derive_buddy), and NOT an input of any
+    # engine program (the round program is handed the state without it;
+    # the sync program writes the fresh copy).
+    buddy: PyTree = None
 
 
 def _first_worker_row(x):
@@ -389,7 +404,7 @@ class LocalSGDEngine:
     config) triple."""
 
     def __init__(self, model, mesh, cfg: Config, train_model=None,
-                 param_specs_fn=None):
+                 param_specs_fn=None, nan_screen: bool = False):
         self.model = model              # dense-attention model: init/probe/eval
         self.train_model = train_model or model  # round-program model (may use
         #                                 ring attention over the seq axis
@@ -540,6 +555,35 @@ class LocalSGDEngine:
                 "'replicated' — see docs/ARCHITECTURE.md",
                 cfg.aggregation_by, cfg.aggregation_type)
         self.resident_on = self.param_residency == "resident"
+        # --- buddy-redundant resident shards (ISSUE 12) -----------------
+        # The hop exists to protect state no other worker holds: the
+        # scatter-resident params rows and/or the SHARDED round-opt
+        # moment rows.  auto = on exactly when either resolves; an
+        # explicit "buddy" with nothing shard-resident demotes with a
+        # log (config rejected the eagerly-decidable cases).
+        redundancy = getattr(cfg, "shard_redundancy", "auto")
+        self.buddy_on = (
+            redundancy != "off" and self.n_workers >= 2
+            and (self.resident_on
+                 or (self.round_opt_on
+                     and self.opt_placement == "sharded")))
+        if redundancy == "buddy" and not self.buddy_on:
+            log.info(
+                "shard_redundancy buddy requested but nothing resolves "
+                "shard-resident (param_residency=%s, round_opt=%s, "
+                "workers=%d): every span already lives on all workers — "
+                "resolved to 'off'", self.param_residency,
+                self.round_opt_on, self.n_workers)
+        # --- NaN/Inf integrity screen (ISSUE 12) ------------------------
+        # Armed by the driver exactly when the chaos schedule can poison
+        # a contribution (nan@R:wI): the sync programs then take a
+        # per-worker poison flag, screen every contribution sender-side,
+        # renormalize the blend over the finite survivors, and emit
+        # per-worker validity flags the driver turns into quarantine
+        # strikes.  Clean rounds are bitwise-identical to the unscreened
+        # program (comms), which is why this is a compile-time arming,
+        # not an always-on input.
+        self.nan_screen = bool(nan_screen)
         # per-worker params template (ShapeDtypeStructs, no worker
         # axis): set by init_state / stage_state, or installed from a
         # MembershipSnapshot — the resident layout's bucket plan, entry
@@ -574,11 +618,12 @@ class LocalSGDEngine:
         """
         return self.cfg.resolve_sync_mode(jax.default_backend())
 
-    def _sync_body(self, params, grads, residual, round_opt=None):
+    def _sync_body(self, params, grads, residual, round_opt=None,
+                   poison=None):
         """The once-per-round sync point, per worker (inside shard_map).
 
-        Returns ``(params', resident', residual', round_opt',
-        agg_grad_norm)``.  Weights mode replaces params with the
+        Returns ``(params', resident', residual', round_opt', buddy',
+        ok, agg_grad_norm)``.  Weights mode replaces params with the
         aggregate (FedAvg) — under the resident layout (ISSUE 11) the
         program ENDS at the scatter instead: ``params'`` is None and
         ``resident'`` carries the post-apply 1/N bucket shards, the
@@ -587,37 +632,73 @@ class LocalSGDEngine:
         grads and reports only their norm (reference semantics,
         SURVEY.md 3.2) — plus, when the round-optimizer tracker is armed
         (ISSUE 9), the shard-resident Adam moment update of the
-        aggregated mean gradient."""
+        aggregated mean gradient.
+
+        ``buddy'`` (ISSUE 12) is the ring-successor copy of this
+        worker's shard-resident spans when ``buddy_on`` (None
+        otherwise); ``ok`` is this worker's fp32 contribution-validity
+        flag when the NaN screen is armed and ``poison`` given (None
+        otherwise)."""
         cfg = self.cfg
         agg_grad_norm = jnp.zeros(())
         resident = None
+        buddy = None
+        ok = None
+        screen = poison is not None
         fast = self.sync_mode in ("sharded", "gossip")
         if cfg.aggregation_by == "weights":
             if self.resident_on:
-                resident, residual, _ = comms.sharded_opt_sync(
-                    params,
+                rets = comms.sharded_opt_sync(
+                    params, buddy=self.buddy_on,
+                    poison=poison if screen else None,
                     **self._fast_kwargs(residual if self.sync_ef
                                         else None))
+                resident, residual = rets[0], rets[1]
+                idx = 3
+                if self.buddy_on:
+                    buddy = rets[idx]
+                    idx += 1
+                if screen:
+                    ok = rets[idx]
                 params = None
             elif fast:
-                params, residual = self._fast_sync(
-                    params, residual if self.sync_ef else None)
+                params, residual, ok = self._fast_sync(
+                    params, residual if self.sync_ef else None,
+                    poison=poison)
             else:
-                params = comms.aggregate(
-                    params, how=cfg.aggregation_type,
-                    topology=cfg.topology, local_weight=cfg.local_weight)
+                params, ok = self._dense_sync(params, poison)
         else:
             if self.round_opt_on:
-                agg, _, round_opt = comms.sharded_opt_sync(
-                    grads, tracker=round_opt, **self._fast_kwargs())
+                rets = comms.sharded_opt_sync(
+                    grads, tracker=round_opt, buddy=self.buddy_on,
+                    poison=poison if screen else None,
+                    **self._fast_kwargs())
+                agg, round_opt = rets[0], rets[2]
+                idx = 3
+                if self.buddy_on:
+                    buddy = rets[idx]
+                    idx += 1
+                if screen:
+                    ok = rets[idx]
             elif fast:
-                agg, _ = self._fast_sync(grads, None)
+                agg, _, ok = self._fast_sync(grads, None, poison=poison)
             else:
-                agg = comms.aggregate(
-                    grads, how=cfg.aggregation_type,
-                    topology=cfg.topology, local_weight=cfg.local_weight)
+                agg, ok = self._dense_sync(grads, poison)
             agg_grad_norm = self._grad_global_norm(agg)
-        return params, resident, residual, round_opt, agg_grad_norm
+        return params, resident, residual, round_opt, buddy, ok, \
+            agg_grad_norm
+
+    def _dense_sync(self, tree, poison):
+        """Legacy dense per-leaf aggregate, screen-aware: returns
+        ``(aggregated, ok_or_None)``."""
+        cfg = self.cfg
+        if poison is not None:
+            return comms.aggregate(
+                tree, how=cfg.aggregation_type, topology=cfg.topology,
+                local_weight=cfg.local_weight, poison=poison)
+        return comms.aggregate(
+            tree, how=cfg.aggregation_type, topology=cfg.topology,
+            local_weight=cfg.local_weight), None
 
     def _fast_kwargs(self, residual=None) -> dict:
         """Shared kwargs of the bucketed sharded engine calls, including
@@ -634,20 +715,24 @@ class LocalSGDEngine:
                     opt_placement=placement,
                     residency=self.param_residency)
 
-    def _fast_sync(self, tree, residual):
+    def _fast_sync(self, tree, residual, poison=None):
         """Run the resolved bucketed fast engine on one pytree:
         the reduce-scatter program for ``sharded``, the ppermute gossip
         program for ``gossip`` — same kwargs, same
-        ``(out, new_residual)`` contract."""
+        ``(out, new_residual, ok_or_None)`` contract."""
         if self.sync_mode == "gossip":
             kw = self._fast_kwargs(residual)
             # gossip has no apply stage to place and no scatter whose
             # output could stay resident (worker-local blends)
             kw.pop("opt_placement")
             kw.pop("residency")
-            return comms.gossip_sync(tree, topology=self.cfg.topology,
-                                     **kw)
-        return comms.sharded_sync(tree, **self._fast_kwargs(residual))
+            rets = comms.gossip_sync(tree, topology=self.cfg.topology,
+                                     poison=poison, **kw)
+        else:
+            rets = comms.sharded_opt_sync(tree, poison=poison,
+                                          **self._fast_kwargs(residual))
+        return rets[0], rets[1], (rets[-1] if poison is not None
+                                  else None)
 
     def _arm_sync_stats(self, params_stacked) -> None:
         """Reset ``last_sync_stats`` for the round being dispatched: the
@@ -675,6 +760,18 @@ class LocalSGDEngine:
                 shapes, self.n_workers, mode=self.sync_mode,
                 wire_dtype=wire, bucket_bytes=self.sync_bucket_bytes,
                 topology=self.cfg.topology)
+            if self.buddy_on:
+                # ISSUE 12: the buddy hop's wire bytes ride the same
+                # accounting — one extra ppermute per bucket carrying
+                # the shard-resident rows (tests/test_sync.py asserts
+                # redundancy-on == baseline + buddy_wire_bytes exactly)
+                self._sync_bytes += comms.buddy_wire_bytes(
+                    shapes, self.n_workers, wire_dtype=wire,
+                    bucket_bytes=self.sync_bucket_bytes,
+                    params=self.resident_on,
+                    tracker=(self.round_opt_on
+                             and self.opt_placement == "sharded"),
+                    ef=self.resident_on and self.sync_ef)
         self.last_sync_stats = {"sync_bytes": self._sync_bytes,
                                 "sync_mode": self.sync_mode,
                                 "sync_ms": 0.0}
@@ -721,7 +818,48 @@ class LocalSGDEngine:
                 "params_gathered_peak": gathered_peak,
                 "opt_state": per_worker(state.opt_state),
                 "ef_residual": per_worker(state.sync_residual),
-                "round_opt": per_worker(state.round_opt)}
+                "round_opt": per_worker(state.round_opt),
+                # ISSUE 12: the buddy copy's per-worker cost — one extra
+                # shard-row set, i.e. ~1/N of each protected component
+                "buddy": per_worker(state.buddy)}
+
+    def _derive_buddy_host(self, state: TrainState):
+        """Host-derive the buddy rows a state implies (ISSUE 12): a
+        small fetch of the shard-resident layouts (each ~1/N of the
+        params), ring-rolled by ``comms.derive_buddy``.  Off the hot
+        path by construction — used at init/restore/restage only (the
+        round loop's copies come from the fused sync hop)."""
+        fetch = lambda t: (None if t is None else
+                           jax.tree_util.tree_map(np.asarray,
+                                                  _host_fetch(t)))
+        return comms.derive_buddy(
+            self.params_template, self.n_workers,
+            bucket_bytes=self.sync_bucket_bytes,
+            params_resident=fetch(state.params_resident),
+            round_opt=(fetch(state.round_opt)
+                       if self.round_opt_on
+                       and self.opt_placement == "sharded" else None),
+            residual=fetch(state.sync_residual)
+            if self.resident_on and self.sync_ef else None,
+            opt_placement=self.opt_placement)
+
+    def refresh_buddy(self, state: TrainState) -> TrainState:
+        """Return ``state`` with its buddy rows (re)derived and staged —
+        the checkpoint-restore path's completion step (buddy rows are
+        stripped from checkpoints; see TrainState.buddy)."""
+        if not self.buddy_on:
+            return state
+        bud = self._derive_buddy_host(state)
+        return state.replace(buddy=jax.tree_util.tree_map(
+            lambda x: self._put(x, self._spec), bud))
+
+    def stage_poison(self, flags: np.ndarray):
+        """Stage a per-worker poison vector for the NaN-screened round
+        (ISSUE 12): an EXPLICIT device_put (transfer-guard-safe in the
+        sanitized round loop) of ``[N]`` bools sharded over the worker
+        axis."""
+        arr = np.asarray(flags, np.bool_).reshape(self.n_workers)
+        return self._put(arr, self._spec)
 
     def materialize_params(self, state: TrainState) -> PyTree:
         """HOST per-worker consensus params of a possibly
@@ -831,6 +969,13 @@ class LocalSGDEngine:
             jax.device_get(params), n,
             bucket_bytes=self.sync_bucket_bytes)
             if self.resident_on else None)
+        sync_residual = (jax.tree_util.tree_map(
+            lambda x: jnp.zeros((n, *x.shape), jnp.float32), params)
+            if self.sync_ef else None)
+        round_opt = (comms.round_opt_init(
+            params, n, placement=self.opt_placement,
+            bucket_bytes=self.sync_bucket_bytes)
+            if self.round_opt_on else None)
         state = TrainState(
             params=None if self.resident_on else tile(params),
             params_resident=resident,
@@ -840,13 +985,19 @@ class LocalSGDEngine:
             rng=jax.vmap(lambda i: jax.random.key_data(
                 jax.random.fold_in(jax.random.key(self.cfg.seed), i)))(
                     jnp.arange(n)),
-            sync_residual=(jax.tree_util.tree_map(
-                lambda x: jnp.zeros((n, *x.shape), jnp.float32), params)
-                if self.sync_ef else None),
-            round_opt=(comms.round_opt_init(
-                params, n, placement=self.opt_placement,
-                bucket_bytes=self.sync_bucket_bytes)
-                if self.round_opt_on else None),
+            sync_residual=sync_residual,
+            round_opt=round_opt,
+            # ISSUE 12: the buddy copy exists from round 0 on (derivable
+            # on host — ring-rolled rows of the layouts above), so every
+            # round program has the one output structure and the
+            # sanitizer's zero-retrace budget holds from the warmup
+            buddy=(comms.derive_buddy(
+                self.params_template, n,
+                bucket_bytes=self.sync_bucket_bytes,
+                params_resident=resident, round_opt=round_opt,
+                residual=sync_residual,
+                opt_placement=self.opt_placement)
+                if self.buddy_on else None),
         )
         return self.stage_state(state)
 
@@ -874,6 +1025,16 @@ class LocalSGDEngine:
                 lambda x: jax.ShapeDtypeStruct(tuple(np.shape(x)[1:]),
                                                np.dtype(x.dtype)),
                 state.params)
+        if self.buddy_on and state.buddy is None:
+            # ISSUE 12: buddy rows are derivable (ring-rolled resident
+            # rows) and deliberately absent from checkpoints and
+            # redundancy-off snapshots — rebuild them here so every
+            # restage lands a complete state whatever its source
+            state = state.replace(buddy=self._derive_buddy_host(state))
+        elif not self.buddy_on and state.buddy is not None:
+            # a redundancy-on snapshot restaged into a redundancy-off
+            # engine just drops the copy (it is derived state)
+            state = state.replace(buddy=None)
         if self.param_specs_fn is not None:
             if self.param_specs is None:
                 p0 = jax.tree_util.tree_map(
@@ -1346,7 +1507,14 @@ class LocalSGDEngine:
         augment = cfg.augment and len(shapes_key[0]) == 5  # [S,B,H,W,C]
         train_step, eval_step = self._make_step_fns(augment)
 
-        def per_worker(state: TrainState, x, y, m, xv, yv, mv):
+        # the fused (CPU) sync point screens contributions when the NaN
+        # screen is armed: the round program then takes the per-worker
+        # poison flag and emits per-worker validity; under split_sync
+        # the standalone sync program carries both instead
+        fused_screen = self.nan_screen and not self.split_sync
+
+        def per_worker(state: TrainState, x, y, m, xv, yv, mv,
+                       poison=None):
             """One worker's round.  x:[S,B,...] y,m:[S,B]; val likewise."""
             if self.resident_on:
                 # ISSUE 11 round-entry gather: the between-round state is
@@ -1415,10 +1583,13 @@ class LocalSGDEngine:
             residual = state.sync_residual
             round_opt = state.round_opt
             resident = None
+            new_buddy = None
+            sync_ok = None
             if not self.split_sync:
-                params, resident, residual, round_opt, agg_grad_norm = \
-                    self._sync_body(params, last_grads, residual,
-                                    round_opt)
+                params, resident, residual, round_opt, new_buddy, \
+                    sync_ok, agg_grad_norm = self._sync_body(
+                        params, last_grads, residual, round_opt,
+                        poison=poison)
 
             # cross-worker global-epoch metric means (trainer.py:152-162)
             metrics = dict(
@@ -1433,11 +1604,13 @@ class LocalSGDEngine:
                 global_val_acc=lax.pmean(
                     per_epoch["val_acc"].mean(), DATA_AXIS),
             )
+            if sync_ok is not None:
+                metrics = dict(metrics, sync_ok=sync_ok)
             new_state = TrainState(params=params, params_resident=resident,
                                    batch_stats=batch_stats,
                                    opt_state=opt_state, lr_epoch=lr_epoch,
                                    rng=rng, sync_residual=residual,
-                                   round_opt=round_opt)
+                                   round_opt=round_opt, buddy=new_buddy)
             if emit_grads:
                 # split_sync x gradients mode: the standalone sync program
                 # aggregates the stale last-batch grads, so the round
@@ -1445,11 +1618,13 @@ class LocalSGDEngine:
                 return new_state, last_grads, metrics
             return new_state, metrics
 
-        def stacked(state, x, y, m, xv, yv, mv):
+        def stacked(state, x, y, m, xv, yv, mv, *rest):
             squeeze = lambda t: jax.tree_util.tree_map(lambda a: a[0], t)
             expand = lambda t: jax.tree_util.tree_map(lambda a: a[None], t)
+            poi = squeeze(rest[0]) if rest else None
             outs = per_worker(
-                squeeze(state), *map(lambda a: a[0], (x, y, m, xv, yv, mv)))
+                squeeze(state), *map(lambda a: a[0], (x, y, m, xv, yv, mv)),
+                poison=poi)
             new_state = self._certify_replication(outs[0], sspec)
             metrics = self._certify_replication(outs[-1], self._spec)
             mid = tuple(self._certify_replication(o, pspec)
@@ -1460,6 +1635,8 @@ class LocalSGDEngine:
         pspec = self._sspec.params if self._sspec is not None else self._spec
         emit_grads = self.split_sync and cfg.aggregation_by == "gradients"
         in_specs = (sspec,) + self._pack_specs(shapes_key) * 2
+        if fused_screen:
+            in_specs = in_specs + (self._spec,)
         out_specs = ((sspec, pspec, self._spec) if emit_grads
                      else (sspec, self._spec))
         fn = shard_map(
@@ -1508,7 +1685,8 @@ class LocalSGDEngine:
         stage = lambda p: (put(p[0], xs), put(p[1], ys), put(p[2], ms))
         return stage(train_pack), stage(val_pack)
 
-    def round_start(self, state: TrainState, train_pack, val_pack):
+    def round_start(self, state: TrainState, train_pack, val_pack,
+                    poison=None):
         """Stage (if not already staged) + dispatch one global epoch
         WITHOUT blocking on it.
 
@@ -1521,19 +1699,34 @@ class LocalSGDEngine:
         any thread) to obtain the round's host metric arrays.  Callers
         must ``round_wait`` before dispatching the next round — at most
         one round program in flight (1-core CPU hosts deadlock on
-        pipelined collective rendezvous)."""
+        pipelined collective rendezvous).
+
+        ``poison`` (ISSUE 12, NaN-screened engines only): the staged
+        [N]-bool per-worker poison vector (``stage_poison``); defaults
+        to all-clear.  The previous round's buddy rows are NOT an input
+        of any program — they are dropped here (the sync writes the
+        fresh copy) so the whole remaining state donates cleanly."""
         if not isinstance(train_pack[0], jax.Array):
             train_pack, val_pack = self.stage_pack(train_pack, val_pack)
+        if state.buddy is not None:
+            # previous round's buddy rows: derived state, not a program
+            # input — the sync below writes the fresh copy (the old
+            # buffers free when the caller rebinds its state)
+            state = state.replace(buddy=None)
         x, y, m = train_pack
         xv, yv, mv = val_pack
         key = (tuple(x.shape[1:]), tuple(xv.shape[1:]))
         if key not in self._round_cache:
             log.info("compiling round program for shapes %s", key)
             self._round_cache[key] = self._build_round(key)
-        outs = self._round_cache[key](state, x, y, m, xv, yv, mv)
+        if self.nan_screen and poison is None:
+            poison = self.stage_poison(np.zeros(self.n_workers, np.bool_))
+        extra = ((poison,) if self.nan_screen and not self.split_sync
+                 else ())
+        outs = self._round_cache[key](state, x, y, m, xv, yv, mv, *extra)
         new_state, metrics = outs[0], outs[-1]
         self._arm_sync_stats(new_state.params)
-        sync_norm = fence = None
+        sync_norm = fence = sync_ok = None
         if self.split_sync:
             # the sync program consumes the round's outputs, so its
             # dispatch chains behind the still-running round program; the
@@ -1542,32 +1735,34 @@ class LocalSGDEngine:
                 self._round_cache["sync"] = self._build_sync()
             sync = self._round_cache["sync"]
             if self.cfg.aggregation_by == "weights":
-                if self.sync_ef:
-                    synced, residual, fence = sync(new_state.params,
-                                                   new_state.sync_residual)
-                else:
-                    synced, fence = sync(new_state.params)
-                    residual = new_state.sync_residual
+                d = (sync(new_state.params, new_state.sync_residual,
+                          poison=poison) if self.sync_ef
+                     else sync(new_state.params, poison=poison))
+                residual = d.get("residual", new_state.sync_residual)
                 if self.resident_on:
                     # the sync ended at the scatter: the resident bucket
                     # shards replace the (donated) full params as the
                     # between-round state
                     new_state = new_state.replace(
-                        params=None, params_resident=synced,
-                        sync_residual=residual)
+                        params=None, params_resident=d["out"],
+                        sync_residual=residual,
+                        buddy=d.get("buddy"))
                 else:
-                    new_state = new_state.replace(params=synced,
+                    new_state = new_state.replace(params=d["out"],
                                                   sync_residual=residual)
+                fence = d["fence"]
             else:
                 if self.round_opt_on:
-                    sync_norm, new_tracker = sync(outs[1],
-                                                  new_state.round_opt)
-                    new_state = new_state.replace(round_opt=new_tracker)
+                    d = sync(outs[1], new_state.round_opt, poison=poison)
+                    new_state = new_state.replace(
+                        round_opt=d["tracker"], buddy=d.get("buddy"))
                 else:
-                    sync_norm = sync(outs[1])
+                    d = sync(outs[1], poison=poison)
+                sync_norm = d["out"]
                 fence = sync_norm
+            sync_ok = d.get("ok")
             self._sync_probe = (metrics["train_loss"], fence)
-        return new_state, ("packed", metrics, sync_norm, fence)
+        return new_state, ("packed", metrics, sync_norm, fence, sync_ok)
 
     def round_wait(self, new_state: TrainState) -> TrainState:
         """Block until a dispatched round's state is materialized — the
@@ -1613,7 +1808,7 @@ class LocalSGDEngine:
         state (whose buffers the NEXT round's dispatch already donated)."""
         if handle[0] != "packed":
             raise ValueError("round_done_marker applies to packed rounds")
-        _, metrics, _sync_norm, fence = handle
+        _, metrics, _sync_norm, fence, _ok = handle
         return fence if fence is not None else metrics["train_loss"]
 
     def finish_metrics(self, handle) -> dict:
@@ -1623,15 +1818,22 @@ class LocalSGDEngine:
         from a worker thread while the NEXT round is already running —
         the overlapped driver pipeline does exactly that."""
         if handle[0] == "packed":
-            _, metrics, sync_norm, _fence = handle
+            _, metrics, sync_norm, _fence, sync_ok = handle
             mx = self._fetch(metrics)
             if sync_norm is not None:
                 # split_sync x gradients mode: the norm came from the
                 # standalone sync program, not the round program
                 mx["agg_grad_norm"] = self._fetch(sync_norm)
+            if sync_ok is not None:
+                # split_sync x NaN screen: validity came from the
+                # standalone sync program
+                mx["sync_ok"] = self._fetch(sync_ok)
             return mx
-        _, per_epoch, agg_grad_norm = handle
-        return self._assemble_streamed(per_epoch, agg_grad_norm)
+        _, per_epoch, agg_grad_norm, sync_ok = handle
+        mx = self._assemble_streamed(per_epoch, agg_grad_norm)
+        if sync_ok is not None:
+            mx["sync_ok"] = self._fetch(sync_ok)
+        return mx
 
     def round(self, state: TrainState, train_pack, val_pack):
         """Serial convenience wrapper: dispatch, block, fetch."""
@@ -1714,13 +1916,18 @@ class LocalSGDEngine:
         dense twin — with the inputs donated so the once-per-round
         parameter sync updates in place.
 
-        The extra ``fence`` output (weights mode) is a tiny per-worker
-        scalar derived from the synced params: a never-donated completion
-        marker for the sync-wall probe and the deep-pipeline driver."""
+        Returns a callable ``run(primary[, residual_or_tracker],
+        poison=None)`` producing a DICT: ``out`` (synced params /
+        resident shards / agg norm), plus ``residual`` / ``tracker`` /
+        ``buddy`` (ISSUE 12 ring-successor copies) / ``ok`` (ISSUE 12
+        per-worker validity) as armed, and ``fence`` — a tiny
+        never-donated per-worker scalar marker for the sync-wall probe
+        and the deep-pipeline driver (in gradients mode ``out`` IS the
+        fence)."""
         cfg = self.cfg
 
-        def _fence(params):
-            f = jnp.sum(jax.tree_util.tree_leaves(params)[0]).astype(
+        def _fence(tree):
+            f = jnp.sum(jax.tree_util.tree_leaves(tree)[0]).astype(
                 jnp.float32)
             # a TP/PP/EP-sharded leaf sums to a shard-varying value; make
             # the fence invariant along inner axes so the P(data) out-spec
@@ -1728,64 +1935,82 @@ class LocalSGDEngine:
             return lax.psum(f, self._inner_axes) if self._inner_axes else f
 
         pspec = self._sspec.params if self._sspec is not None else self._spec
-        if cfg.aggregation_by == "weights":
-            if self.resident_on:
-                # ISSUE 11: the standalone sync ENDS at the scatter — it
-                # consumes (donates) the round's full post-training
-                # params and returns the post-apply 1/N bucket shards,
-                # the only parameter state alive between rounds
-                if self.sync_ef:
-                    def per_worker(params, residual):
-                        _p, res, r, _t, _ = self._sync_body(params, None,
-                                                            residual)
-                        return res, r, _fence(res)
-                    return self._wrap_stacked(
-                        per_worker, [pspec, pspec],
-                        out_specs=(self._spec, pspec, self._spec),
-                        donate=(0, 1))
+        weights = cfg.aggregation_by == "weights"
+        takes_residual = weights and self.sync_ef
+        takes_tracker = (not weights) and self.round_opt_on
+        screen = self.nan_screen
 
-                def per_worker(params):
-                    _p, res, _r, _t, _ = self._sync_body(params, None,
-                                                         None)
-                    return res, _fence(res)
-                return self._wrap_stacked(per_worker, [pspec],
-                                          out_specs=(self._spec,
-                                                     self._spec),
-                                          donate=(0,))
-            if self.sync_ef:
-                def per_worker(params, residual):
-                    p, _res, r, _t, _ = self._sync_body(params, None,
-                                                        residual)
-                    return p, r, _fence(p)
-                return self._wrap_stacked(
-                    per_worker, [pspec, pspec],
-                    out_specs=(pspec, pspec, self._spec), donate=(0, 1))
+        def per_worker(*args):
+            idx = 0
+            primary = args[idx]
+            idx += 1
+            residual = tracker = poi = None
+            if takes_residual:
+                residual = args[idx]
+                idx += 1
+            if takes_tracker:
+                tracker = args[idx]
+                idx += 1
+            if screen:
+                poi = args[idx]
+            if weights:
+                p, res, r, _t, bud, ok, _ = self._sync_body(
+                    primary, None, residual, poison=poi)
+                out = res if self.resident_on else p
+                d = {"out": out, "fence": _fence(out)}
+                if takes_residual:
+                    d["residual"] = r
+            else:
+                _p, _res, _r, trk, bud, ok, norm = self._sync_body(
+                    None, primary, None, tracker, poison=poi)
+                d = {"out": norm}
+                if takes_tracker:
+                    d["tracker"] = trk
+            if bud is not None:
+                d["buddy"] = bud
+            if ok is not None:
+                d["ok"] = ok
+            return d
 
-            def per_worker(params):
-                p, _res, _r, _t, _ = self._sync_body(params, None, None)
-                return p, _fence(p)
-            return self._wrap_stacked(per_worker, [pspec],
-                                      out_specs=(pspec, self._spec),
-                                      donate=(0,))
+        in_specs = [pspec]
+        donate = [0]
+        if takes_residual:
+            in_specs.append(pspec)
+            donate.append(1)
+        if takes_tracker:
+            in_specs.append(self._spec)
+            donate.append(1)
+        if screen:
+            in_specs.append(self._spec)   # [N] poison flags, not donated
+        out_specs: dict = {"out": (self._spec if (self.resident_on
+                                                  or not weights)
+                                   else pspec)}
+        if weights:
+            out_specs["fence"] = self._spec
+        if takes_residual:
+            out_specs["residual"] = pspec
+        if takes_tracker:
+            out_specs["tracker"] = self._spec
+        if self.buddy_on:
+            out_specs["buddy"] = self._spec
+        if screen:
+            out_specs["ok"] = self._spec
+        prog = self._wrap_stacked(per_worker, in_specs,
+                                  out_specs=out_specs,
+                                  donate=tuple(donate))
 
-        if self.round_opt_on:
-            # gradients mode with the round-optimizer tracker (ISSUE 9):
-            # the standalone program consumes and donates the tracker
-            # rows alongside the grads — shard-resident moments update in
-            # place between the scatter and the norm's gather
-            def per_worker(grads, round_opt):
-                _p, _res, _r, trk, norm = self._sync_body(None, grads,
-                                                          None, round_opt)
-                return norm, trk
-            return self._wrap_stacked(per_worker, [pspec, self._spec],
-                                      out_specs=(self._spec, self._spec),
-                                      donate=(0, 1))
+        def run(*args, poison=None):
+            if screen:
+                if poison is None:
+                    poison = self.stage_poison(
+                        np.zeros(self.n_workers, np.bool_))
+                args = args + (poison,)
+            d = dict(prog(*args))
+            if not weights:
+                d["fence"] = d["out"]
+            return d
 
-        def per_worker(grads):
-            _p, _res, _r, _t, norm = self._sync_body(None, grads, None)
-            return norm
-        return self._wrap_stacked(per_worker, [pspec],
-                                  out_specs=self._spec, donate=(0,))
+        return run
 
     def _staged_chunks(self, gen):
         """Iterator of device-staged (x, y, m) chunk triples.
@@ -1806,7 +2031,7 @@ class LocalSGDEngine:
         return map(stage, gen)
 
     def round_streamed_start(self, state: TrainState, train_chunks,
-                             val_chunks):
+                             val_chunks, poison=None):
         """Dispatch one streamed global epoch; metric fetch is deferred.
 
         ``train_chunks(epoch)`` / ``val_chunks(epoch)`` return an iterator
@@ -1819,6 +2044,10 @@ class LocalSGDEngine:
         while the next round computes.
         """
         cfg = self.cfg
+        if state.buddy is not None:
+            # previous round's buddy rows: derived state, not a program
+            # input — the standalone sync writes the fresh copy below
+            state = state.replace(buddy=None)
         # Fresh-grads program, built ONCE per engine (a per-call
         # ``jax.jit(lambda ...)`` here was a graftlint R2 true positive:
         # every round paid a fresh retrace+compile).  out_shardings pins
@@ -1909,11 +2138,15 @@ class LocalSGDEngine:
         residual = state.sync_residual
         round_opt = state.round_opt
         resident = None
+        new_buddy = None
+        sync_ok = None
         if cfg.aggregation_by == "weights":
-            if self.sync_ef:
-                synced, residual, fence = sync(params, residual)
-            else:
-                synced, fence = sync(params)
+            d = (sync(params, residual, poison=poison) if self.sync_ef
+                 else sync(params, poison=poison))
+            synced, fence = d["out"], d["fence"]
+            residual = d.get("residual", residual)
+            new_buddy = d.get("buddy")
+            sync_ok = d.get("ok")
             if self.resident_on:
                 # the sync ended at the scatter: only the bucket shards
                 # survive the round (the donated full params are gone)
@@ -1927,9 +2160,13 @@ class LocalSGDEngine:
                 np.zeros((self.n_workers,), np.float32), self._spec)
         else:
             if self.round_opt_on:
-                agg_grad_norm, round_opt = sync(last_grads, round_opt)
+                d = sync(last_grads, round_opt, poison=poison)
+                round_opt = d["tracker"]
             else:
-                agg_grad_norm = sync(last_grads)
+                d = sync(last_grads, poison=poison)
+            agg_grad_norm = d["out"]
+            new_buddy = d.get("buddy")
+            sync_ok = d.get("ok")
             fence = agg_grad_norm
         # everything before the sync is already materialized (the
         # per-epoch barrier above), so the block on the fence times the
@@ -1948,8 +2185,9 @@ class LocalSGDEngine:
             params=params, params_resident=resident,
             batch_stats=batch_stats, opt_state=opt_state,
             lr_epoch=self._round_cache["bump_epoch"](state.lr_epoch),
-            rng=rng, sync_residual=residual, round_opt=round_opt)
-        return new_state, ("streamed", per_epoch, agg_grad_norm)
+            rng=rng, sync_residual=residual, round_opt=round_opt,
+            buddy=new_buddy)
+        return new_state, ("streamed", per_epoch, agg_grad_norm, sync_ok)
 
     def _assemble_streamed(self, per_epoch, agg_grad_norm) -> dict:
         """Fetch + assemble a streamed round's metrics into the same mx

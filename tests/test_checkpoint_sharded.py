@@ -86,3 +86,78 @@ class TestShardedResume:
         specs = [str(l.sharding.spec) for l in
                  jax.tree_util.tree_leaves(res["state"].params)]
         assert any("model" in s for s in specs)
+
+
+class TestResidentCrcFallback:
+    """ISSUE 12 satellite: the PR 8 crc32 corrupt-newest-epoch fallback
+    was untested under ``param_residency=resident`` shard layouts — the
+    1/N bucket rows are the storage unit there, so a corrupt resident
+    shard must drop its epoch from the committed listing exactly like a
+    replicated one, and the fallback epoch must restore the resident
+    rows bitwise (buddy rows are stripped from the save and re-derived
+    on restore)."""
+
+    def _resident_engine(self, mesh):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import (
+            get_model,
+        )
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.train import (
+            LocalSGDEngine,
+        )
+        cfg = Config(model="mlp", epochs_local=1, batch_size=8,
+                     compute_dtype="float32", augment=False,
+                     aggregation_by="weights", sync_mode="sharded")
+        eng = LocalSGDEngine(get_model("mlp", num_classes=10, hidden=8),
+                             mesh, cfg)
+        assert eng.param_residency == "resident" and eng.buddy_on
+        return eng
+
+    def test_corrupt_newest_resident_epoch_falls_back_bitwise(
+            self, mesh8, tmp_path):
+        import os
+        import json
+        eng = self._resident_engine(mesh8)
+        s1 = eng.init_state(jax.random.key(0),
+                            np.zeros((8, 28, 28, 1), np.float32))
+        s2 = eng.init_state(jax.random.key(7),
+                            np.zeros((8, 28, 28, 1), np.float32))
+        ck = C.CheckpointEngine(str(tmp_path), async_write=False)
+        ck.save(s1, 1)
+        ck.save(s2, 2)
+        # the save stripped the derived buddy rows: no .buddy leaves
+        manifest = json.load(
+            open(tmp_path / "ckpt_2" / C.MANIFEST))
+        assert all(not k.startswith(".buddy")
+                   for k in manifest["leaves"])
+        assert any(k.startswith(".params_resident[")
+                   for k in manifest["leaves"])
+        # bit rot that PRESERVES the byte size: crc32 must catch it
+        sh = tmp_path / "ckpt_2" / "shard_0.msgpack"
+        raw = bytearray(sh.read_bytes())
+        raw[len(raw) // 2] ^= 0xFF
+        sh.write_bytes(bytes(raw))
+        assert C.committed_epochs(str(tmp_path)) == [1]
+        latest = C.latest_checkpoint(str(tmp_path))
+        assert latest.endswith("ckpt_1")
+        template = eng.init_state(jax.random.key(3),
+                                  np.zeros((8, 28, 28, 1), np.float32))
+        restored, epoch = C.restore_checkpoint(
+            latest, template, params_template=eng.params_template,
+            bucket_bytes=eng.sync_bucket_bytes)
+        assert epoch == 1
+        assert restored.params is None
+        for k, v in jax.device_get(s1.params_resident).items():
+            np.testing.assert_array_equal(
+                np.asarray(v),
+                np.asarray(jax.device_get(
+                    restored.params_resident)[k]))
+        # the restore template's buddy is stripped too (derived state);
+        # the engine surface rebuilds it bitwise from the restored rows
+        assert restored.buddy is None
+        refreshed = eng.refresh_buddy(restored)
+        for name, bud in jax.device_get(s1.buddy).items():
+            for comp, rows in bud.items():
+                np.testing.assert_array_equal(
+                    np.asarray(rows),
+                    np.asarray(jax.device_get(
+                        refreshed.buddy)[name][comp]))

@@ -152,20 +152,32 @@ class TestStragglerPolicy:
         ids = [0, 1]
         # round 1: worker 1 overruns 15s deadline -> tolerated retry,
         # deadline extends to 10 + 5*1.5 = 17.5
-        departed, retries = pol.observe(ids, np.array([1.0, 16.0]))
-        assert departed == [] and len(retries) == 1
+        departed, crashed, retries = pol.observe(ids,
+                                                 np.array([1.0, 16.0]))
+        assert departed == [] and crashed == [] and len(retries) == 1
         assert retries[0]["worker"] == 1 and retries[0]["attempt"] == 1
         assert retries[0]["next_deadline_s"] == 17.5
         # round 2: still past the EXTENDED deadline -> departed
-        departed, retries = pol.observe(ids, np.array([1.0, 18.0]))
-        assert departed == [1] and retries == []
+        departed, crashed, retries = pol.observe(ids,
+                                                 np.array([1.0, 18.0]))
+        assert departed == [1] and crashed == [] and retries == []
 
     def test_recovery_resets_attempts(self):
         pol = chaos_lib.StragglerPolicy(10.0, 5.0, retries=1, backoff=0.5)
         pol.observe([0], np.array([16.0]))       # retry 1
         pol.observe([0], np.array([1.0]))        # recovered
-        departed, retries = pol.observe([0], np.array([16.0]))
+        departed, crashed, retries = pol.observe([0], np.array([16.0]))
         assert departed == [] and retries[0]["attempt"] == 1
+
+    def test_nonfinite_wall_is_the_distinct_crashed_verdict(self):
+        # ISSUE 12: a missed round fence (non-finite wall) is CRASHED
+        # immediately — no retry ladder, attempt state dropped — while a
+        # finite overrun in the same round keeps the PR 8 ladder
+        pol = chaos_lib.StragglerPolicy(10.0, 5.0, retries=1, backoff=0.5)
+        departed, crashed, retries = pol.observe(
+            [0, 1, 2], np.array([1.0, np.inf, 16.0]))
+        assert crashed == [1] and departed == []
+        assert [r["worker"] for r in retries] == [2]
 
 
 # ----------------------------------------------------------------------

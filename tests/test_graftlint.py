@@ -377,6 +377,41 @@ def enter_round(gather, sync, resident):
 """
         assert "R4" not in rules_for(src)
 
+    def test_buddy_hop_state_use_after_donate_flagged(self):
+        # ISSUE 12 fixture: the buddy-redundant sync program donates the
+        # state whose shard rows it re-scatters AND ring-copies
+        # (train._build_sync donate=(0,...)); reading the donated
+        # state's OLD buddy rows after the call — instead of the fresh
+        # copy the hop just produced — touches freed buffers, the exact
+        # hazard class R4 exists for (the driver therefore drops the
+        # previous buddy before dispatch and reads only the output's)
+        src = """
+import jax
+def sync_round(sync, params, residual):
+    prog = jax.jit(sync, donate_argnums=(0, 1))
+    out = prog(params, residual)
+    stale = residual  # donated EF rows read after the buddy-hop sync
+    return out, stale
+"""
+        assert "R4" in rules_for(src)
+
+    def test_buddy_hop_rebound_to_fresh_copy_clean(self):
+        # the engine's real shape: every protected row (resident shards,
+        # residual, buddy) is rebound to the sync program's OUTPUT dict
+        # before any further read — the fresh ring copy replaces the
+        # donated generation
+        src = """
+import jax
+def sync_round(sync, params, residual):
+    prog = jax.jit(sync, donate_argnums=(0, 1))
+    out = prog(params, residual)
+    params = out["out"]
+    residual = out["residual"]
+    buddy = out["buddy"]
+    return params, residual, buddy
+"""
+        assert "R4" not in rules_for(src)
+
     def test_rebound_name_no_longer_shard_map_clean(self):
         src = """
 import jax

@@ -377,6 +377,62 @@ class TestCheckpointRestore:
             ids.append(int(np.asarray(lg)[0, -1].argmax()))
         assert out["completions"][0].tokens == ids[len(PROMPT):]
 
+    def test_resident_checkpoint_serves_from_bucket_rows(self, served,
+                                                         tmp_path):
+        """ISSUE 12 satellite: a scatter-resident checkpoint (params
+        stored as 1/N bucket shard rows, no ``.params`` leaves) serves —
+        the consensus unpacks template-free from the manifest metadata's
+        ``params_leaves`` (PR 11 left a hard refusal here), bitwise the
+        source params; a resident checkpoint WITHOUT the template keeps
+        a clear refusal."""
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu import (
+            comms,
+        )
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.train import (
+            TrainState,
+        )
+        model, v = served("gpt")
+        n = 2
+        resident = comms.resident_from_tree(
+            jax.tree.map(np.asarray, v["params"]), n)
+        state = TrainState(params=None, params_resident=resident,
+                           batch_stats={}, opt_state={},
+                           lr_epoch=np.zeros(n, np.int32),
+                           rng=np.zeros((n, 2), np.uint32))
+        flat = jax.tree_util.tree_flatten_with_path(v["params"])[0]
+        meta = {"model": "gpt_tiny", "num_classes": VOCAB,
+                "scan_layers": True, "compute_dtype": "float32",
+                "num_kv_heads": 0, "num_experts": 0,
+                "param_residency": "resident", "sync_bucket_mb": 4.0,
+                "params_leaves": [
+                    [[str(getattr(k, "key", k)) for k in path],
+                     [int(d) for d in np.shape(leaf)],
+                     str(np.asarray(leaf).dtype)]
+                    for path, leaf in flat]}
+        ckpt_lib.save_checkpoint(str(tmp_path), state, 1, metadata=meta)
+        eng = ServeEngine.from_checkpoint(
+            str(tmp_path), max_batch=2, page_size=4, max_pages=16,
+            prompt_buckets=(8,), max_seq=12)
+        for a, b in zip(jax.tree.leaves(eng.params),
+                        jax.tree.leaves(v["params"])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        out = ContinuousBatchingScheduler(eng, eos_id=-1).run(
+            [Request(rid=0, prompt=PROMPT, max_new_tokens=3)])
+        ids = list(PROMPT)
+        for _ in range(3):
+            lg = model.apply(v, np.asarray(ids, np.int32)[None],
+                             train=False)
+            ids.append(int(np.asarray(lg)[0, -1].argmax()))
+        assert out["completions"][0].tokens == ids[len(PROMPT):]
+        # a pre-ISSUE-12 resident checkpoint (no params_leaves) still
+        # refuses with instructions instead of crashing
+        legacy = dict(meta)
+        legacy.pop("params_leaves")
+        old = tmp_path / "legacy"
+        ckpt_lib.save_checkpoint(str(old), state, 1, metadata=legacy)
+        with pytest.raises(ValueError, match="params_leaves"):
+            ServeEngine.from_checkpoint(str(old))
+
     def test_manifest_metadata_roundtrip_and_absence(self, served,
                                                      tmp_path):
         model, v = served("gpt")

@@ -540,3 +540,69 @@ class TestShardedSyncInnerAxes:
                 jax.device_get(state.params))
         for a, b in zip(outs["dense"], outs["sharded"]):
             assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestBuddyWireAccounting:
+    """ISSUE 12 satellite: the buddy-redundancy hop's wire bytes ride
+    ``sync_bytes`` — redundancy on must equal baseline + exactly one
+    ppermute hop of the shard-resident rows in the wire dtype, per
+    topology (gossip topologies keep every state worker-local, so
+    redundancy is a no-op there and the accounting is unchanged)."""
+
+    def _engine(self, topology, redundancy, **cfg_kw):
+        cfg_kw.setdefault("aggregation_by", "weights")
+        cfg = Config(model="mlp", batch_size=8, compute_dtype="float32",
+                     augment=False, topology=topology,
+                     sync_mode="sharded", shard_redundancy=redundancy,
+                     **cfg_kw)
+        eng = LocalSGDEngine(get_model("mlp", num_classes=10, hidden=8),
+                             sub_mesh(4), cfg)
+        state = eng.init_state(
+            jax.random.key(0), np.zeros((8, 28, 28, 1), np.float32))
+        eng._arm_sync_stats(state.params)
+        return eng
+
+    @pytest.mark.parametrize("topology", ["allreduce", "ring",
+                                          "double_ring"])
+    def test_redundancy_adds_exactly_one_hop(self, topology):
+        on = self._engine(topology, "auto")
+        off = self._engine(topology, "off")
+        sb_on = on.last_sync_stats["sync_bytes"]
+        sb_off = off.last_sync_stats["sync_bytes"]
+        if topology == "allreduce":
+            # weights x equal x sharded resolves resident -> buddy on
+            assert on.buddy_on and not off.buddy_on
+            expect = comms.buddy_wire_bytes(
+                on.params_template, 4,
+                bucket_bytes=on.sync_bucket_bytes)
+            assert expect > 0
+            assert sb_on == sb_off + expect, (sb_on, sb_off, expect)
+        else:
+            # gossip: nothing shard-resident, redundancy resolves off
+            assert not on.buddy_on
+            assert sb_on == sb_off
+
+    def test_compressed_wire_hop_is_wire_dtype_sized(self):
+        on = self._engine("allreduce", "auto", sync_dtype="bfloat16",
+                          sync_compression="ef")
+        off = self._engine("allreduce", "off", sync_dtype="bfloat16",
+                           sync_compression="ef")
+        # params row in bf16 (2 bytes) + the fp32 EF own-span (4 bytes)
+        expect = comms.buddy_wire_bytes(
+            on.params_template, 4, wire_dtype=jnp.bfloat16,
+            bucket_bytes=on.sync_bucket_bytes, ef=True)
+        assert on.last_sync_stats["sync_bytes"] == \
+            off.last_sync_stats["sync_bytes"] + expect
+
+    def test_tracker_hop_counts_two_fp32_rows(self):
+        on = self._engine("allreduce", "auto",
+                          aggregation_by="gradients")
+        off = self._engine("allreduce", "off",
+                           aggregation_by="gradients")
+        assert on.round_opt_on and on.buddy_on
+        expect = comms.buddy_wire_bytes(
+            on.params_template, 4, params=False, tracker=True,
+            bucket_bytes=on.sync_bucket_bytes)
+        assert expect > 0
+        assert on.last_sync_stats["sync_bytes"] == \
+            off.last_sync_stats["sync_bytes"] + expect
