@@ -818,6 +818,95 @@ def measure_gossip() -> dict:
     return out
 
 
+def measure_hier() -> dict:
+    """Flat vs hierarchical two-level round-sync A/B (ISSUE 13).
+
+    Over the shared ``_sync_bench_fixtures`` pytree: the FLAT sharded
+    allreduce over all S*W workers (the single-level baseline — one
+    psum_scatter/all_gather over one axis) vs the HIERARCHICAL S x W
+    program (inner sharded allreduce over the ``data`` axis x outer
+    ppermute gossip over the ``slice`` axis, ring and double_ring), at
+    fp32 / bf16 / int8 OUTER wire.  Reports per-program walls with the
+    byte-proportional per-level attribution
+    (``probe.attribute_sync_wall`` — a declared model on CPU, where both
+    "wires" are local memcpys), the DCN byte ratios (compressed outer
+    wire at exactly 1/2 and 1/4 of fp32; DCN payload per hop at exactly
+    1/N_inner of a flat gossip's), and the fp32 BITWISE flag against the
+    dense gossip-of-means twin (``comms.make_hier_host_aggregator``).
+    Needs >= 4 devices (a 2 x W layout); smaller hosts report skipped.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu import comms, probe
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu.mesh import build_mesh
+
+    n, mesh_flat, shapes, tree, res0, per_worker, elems = \
+        _sync_bench_fixtures()
+    if n < 4 or n % 2:
+        return {"skipped": f"needs an even device count >= 4, got {n}"}
+    s, w = 2, n // 2
+    mesh_h = build_mesh({"slice": s, "data": w})
+
+    def time_fn(fn, *args):
+        out = fn(tree, *args)
+        jax.block_until_ready(out)
+        samples = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(tree, *args))
+            samples.append(time.perf_counter() - t0)
+        samples.sort()
+        return out, samples[len(samples) // 2]
+
+    flat_fn = comms.make_host_sync(mesh_flat, mode="sharded")
+    (_flat_out, _r), flat_s = time_fn(flat_fn, None)
+    flat_bytes = comms.sync_wire_bytes(per_worker, n, mode="sharded",
+                                       wire_dtype=jnp.float32)
+    flat_gossip_hop = comms.sync_wire_bytes(
+        per_worker, n, mode="gossip", wire_dtype=jnp.float32,
+        topology="ring")
+    out: dict = {"n_workers": n, "layout": f"{s}x{w}",
+                 "param_mb": round(4 * elems / 1e6, 2),
+                 "flat_sharded": {"ms": round(flat_s * 1e3, 3),
+                                  "wire_mb": round(flat_bytes / 1e6, 3)}}
+    ores0 = comms.hier_outer_residual_init(per_worker, w, n)
+    for topo in ("ring", "double_ring"):
+        dense_fn = comms.make_hier_host_aggregator(mesh_h, topology=topo)
+        dense_out = jax.block_until_ready(dense_fn(tree))
+        row: dict = {}
+        for wname, wdt, oresid in (("fp32", None, None),
+                                   ("bf16", jnp.bfloat16, ores0),
+                                   ("int8", jnp.int8, ores0)):
+            fn = comms.make_hier_host_sync(mesh_h, topology=topo,
+                                           outer_wire_dtype=wdt)
+            (h_out, _hr, _ho), h_s = time_fn(fn, None, oresid)
+            split = comms.hier_wire_bytes(per_worker, w, topology=topo,
+                                          outer_wire_dtype=wdt)
+            ici_ms, dcn_ms = probe.attribute_sync_wall(
+                h_s * 1e3, split["ici"], split["dcn"])
+            row[wname] = {
+                "ms": round(h_s * 1e3, 3),
+                "ms_ici": ici_ms, "ms_dcn": dcn_ms,
+                "ici_mb": round(split["ici"] / 1e6, 3),
+                "dcn_mb": round(split["dcn"] / 1e6, 3)}
+            if wname == "fp32":
+                row["bitwise_hier_eq_gossip_of_means"] = bool(all(
+                    np.array_equal(np.asarray(dense_out[k]),
+                                   np.asarray(h_out[k]))
+                    for k in shapes))
+                row["dcn_vs_flat_gossip_hop"] = round(
+                    split["dcn"]
+                    / (comms.GOSSIP_HOPS[topo] * flat_gossip_hop), 4)
+                fp32_dcn = split["dcn"]
+            else:
+                row[wname]["dcn_vs_fp32"] = (round(
+                    split["dcn"] / fp32_dcn, 4) if fp32_dcn else None)
+        out[topo] = row
+    return out
+
+
 def measure_ckpt() -> dict:
     """Blocking vs sharded-blocking vs async checkpoint A/B (ISSUE 5).
 
@@ -1501,6 +1590,7 @@ SHORT = {
     "round_gap": "rgap",
     "sync_collectives": "sync",
     "gossip_collectives": "gossip",
+    "hier_sync": "hier",
     "compile_engine": "compile",
     "ckpt_engine": "ckpt",
     "serve_engine": "serve",
@@ -1534,6 +1624,8 @@ def _run_entry(key: str, entry_budget: float | None = None) -> dict:
         return measure_sync()
     if key == "gossip_collectives":
         return measure_gossip()
+    if key == "hier_sync":
+        return measure_hier()
     if key == "compile_engine":
         return measure_compile()
     if key == "ckpt_engine":
@@ -1636,6 +1728,14 @@ def _emit_headline(details: dict, extra: dict) -> None:
                         else 0}
             d[sk] = {"ring": _gossip_cell(e.get("ring")),
                      "dring": _gossip_cell(e.get("double_ring"))}
+        elif key == "hier_sync":
+            ring = e.get("ring") or {}
+            d[sk] = {"flat": (e.get("flat_sharded") or {}).get("ms"),
+                     "hier": (ring.get("fp32") or {}).get("ms"),
+                     "dcn": (ring.get("fp32") or {}).get("dcn_mb"),
+                     "r8": ring.get("dcn_vs_flat_gossip_hop"),
+                     "same": 1 if ring.get(
+                         "bitwise_hier_eq_gossip_of_means") else 0}
         elif key == "compile_engine":
             d[sk] = {"x": e.get("compile_speedup_L8"),
                      "unr": e.get("compile_unrolled_L8_s"),
@@ -1767,7 +1867,8 @@ def main() -> None:
         # gossip-collective A/Bs, + per-L flash units run before the
         # sacrificial ViT tail
         jobs[at:at] = ([("round_gap", 150), ("sync_collectives", 120),
-                        ("gossip_collectives", 120), ("compile_engine", 150),
+                        ("gossip_collectives", 120), ("hier_sync", 120),
+                        ("compile_engine", 150),
                         ("ckpt_engine", 120), ("serve_engine", 120),
                         ("elastic_membership", 150),
                         ("crash_recovery", 180)]

@@ -634,7 +634,8 @@ def _strip_buddy(state):
 
 
 def restore_checkpoint(path: str, state_template, *,
-                       params_template=None, bucket_bytes: int | None = None):
+                       params_template=None, bucket_bytes: int | None = None,
+                       num_slices: int = 1):
     """Restore ``(state, global_epoch)`` from a checkpoint path.
 
     ``path`` is a committed sharded directory (format 2) or a legacy
@@ -653,18 +654,49 @@ def restore_checkpoint(path: str, state_template, *,
     consensus vector.  ``params_template`` (per-worker ShapeDtypeStructs,
     the engine's) is required for the replicated->resident direction —
     bucket rows carry no leaf shapes; ``bucket_bytes`` defaults to the
-    manifest's recorded ``sync_bucket_mb`` and then the engine default."""
+    manifest's recorded ``sync_bucket_mb`` and then the engine default.
+
+    Cross-SLICE restore (ISSUE 13): ``num_slices`` is the RESTORING
+    run's slice count; the manifest records the saving run's.  Resident
+    bucket rows re-tile across slice layouts wherever the consensus
+    semantics permit — a flat (global-consensus) checkpoint restores
+    into any S x W hierarchical layout (every slice adopts the one
+    consensus) and W re-tiles at a fixed S; a hierarchical checkpoint's
+    PER-SLICE consensuses cannot re-shard to a different slice count
+    (whose slice would a new one inherit?) and are refused with the
+    real reason.  A missing ``sync_residual_outer`` (pre-ISSUE-13 or
+    cross-topology) restores as zero rows — EF correction state is
+    sub-quantum mass, safe to reset."""
     state_template = _strip_buddy(state_template)
     if os.path.isdir(path):
         merged, epoch = host_tree(path)
         flat, treedef = jax.tree_util.tree_flatten_with_path(state_template)
         merged = _relayout_params_residency(
             path, merged, flat, params_template=params_template,
-            bucket_bytes=bucket_bytes)
+            bucket_bytes=bucket_bytes, num_slices=num_slices)
         leaves = []
         for kpath, tmpl in flat:
             key = jax.tree_util.keystr(kpath)
             is_round_opt = key.startswith(".round_opt")
+            is_outer_res = key.startswith(".sync_residual_outer")
+            if is_outer_res and (
+                    key not in merged
+                    or tuple(np.shape(merged[key]))
+                    != tuple(np.shape(tmpl))):
+                # ISSUE 13: absent (pre-hierarchical checkpoint) or
+                # re-tiled outer EF rows restore as zeros — the residual
+                # is accumulated sub-quantum correction mass and resets
+                # safely across topology changes (fresh EF start)
+                if key in merged:
+                    log.warning(
+                        "checkpoint %s outer-residual leaf %s shape %s "
+                        "does not match template %s (slice/worker "
+                        "re-layout) — restoring zero rows", path, key,
+                        np.shape(merged.get(key)), np.shape(tmpl))
+                merged.pop(key, None)
+                leaves.append(_reshard_leaf(
+                    tmpl, np.zeros(np.shape(tmpl), np.dtype(tmpl.dtype))))
+                continue
             if key not in merged:
                 if is_round_opt:
                     # pre-ISSUE-9 checkpoint (or one saved without the
@@ -710,9 +742,112 @@ def restore_checkpoint(path: str, state_template, *,
     return state, int(payload["global_epoch"])
 
 
+def _slice_consensus_vectors(rows: np.ndarray, filled: int,
+                             saved_slices: int) -> list[np.ndarray]:
+    """Split one saved resident bucket's ``[S*W, row]`` rows into the S
+    per-slice FILLED consensus vectors (pad trimmed) — the host form of
+    what each slice's entry gather would reconstruct (ISSUE 13)."""
+    n_rows = int(rows.shape[0])
+    if n_rows % max(1, saved_slices):
+        raise ValueError(
+            f"resident bucket rows ({n_rows}) not divisible by the "
+            f"manifest's slice count ({saved_slices})")
+    per = n_rows // max(1, saved_slices)
+    return [rows[s * per:(s + 1) * per].reshape(-1)[:filled]
+            for s in range(max(1, saved_slices))]
+
+
+def _relayout_resident_slices(path: str, merged: dict, tmpl_flat, *,
+                              params_template, bucket_bytes: int,
+                              saved_slices: int, num_slices: int) -> dict:
+    """Resident -> resident re-layout across slice/worker layouts
+    (ISSUE 13): reconstruct each bucket's per-slice consensus vectors
+    under the SAVED tiling and re-pack them under the TEMPLATE's.
+
+    Permitted: a flat (1-slice, global-consensus) checkpoint into any
+    S x W layout — every slice adopts the one consensus; and a same-S
+    re-tile to a different W.  Refused with the real reason: changing
+    the slice COUNT of a genuinely per-slice state (the consensuses are
+    distinct — no assignment to a different S is semantically defined),
+    unless the slices happen to agree bitwise (then the state IS a
+    global consensus and re-tiles like a flat one)."""
+    from . import comms
+
+    if params_template is None:
+        raise ValueError(
+            f"checkpoint {path} resident layout needs a re-layout "
+            "across slice/worker tilings: pass params_template= (the "
+            "engine's per-worker ShapeDtypeStructs)")
+    t_items = [(jax.tree_util.keystr(p), t) for p, t in tmpl_flat
+               if jax.tree_util.keystr(p).startswith(".params_resident")]
+    leaves = jax.tree_util.tree_leaves(params_template)
+    out = dict(merged)
+    # template tiling: rows = S_t x W_t, shard width from the plan
+    rows_t = int(np.shape(t_items[0][1])[0])
+    if rows_t % max(1, num_slices):
+        raise ValueError(
+            f"restore template resident rows ({rows_t}) not divisible "
+            f"by num_slices ({num_slices})")
+    w_t = rows_t // max(1, num_slices)
+    plan_t = comms.bucket_plan(leaves, w_t, bucket_bytes)
+    # saved tiling: infer W from the saved rows of bucket 0
+    key0 = f".params_resident['{comms._bucket_name(0)}']"
+    if key0 not in merged:
+        raise ValueError(
+            f"checkpoint {path} resident layout has no bucket leaf "
+            f"{key0} (saved with a different sync_bucket_mb?)")
+    rows_s = int(np.shape(merged[key0])[0])
+    if rows_s % max(1, saved_slices):
+        raise ValueError(
+            f"checkpoint resident rows ({rows_s}) not divisible by the "
+            f"manifest's slice count ({saved_slices})")
+    w_s = rows_s // max(1, saved_slices)
+    plan_s = comms.bucket_plan(leaves, w_s, bucket_bytes)
+    if len(plan_s) != len(plan_t):
+        raise ValueError(
+            f"checkpoint {path} resident bucket count ({len(plan_s)}) "
+            f"differs from the template's ({len(plan_t)}) — different "
+            "sync_bucket_mb?")
+    for i, (bs, bt) in enumerate(zip(plan_s, plan_t)):
+        key = f".params_resident['{comms._bucket_name(i)}']"
+        if key not in out:
+            raise ValueError(
+                f"checkpoint {path} resident layout has no bucket leaf "
+                f"{key}")
+        arr = np.asarray(out.pop(key))
+        if arr.shape != (rows_s, bs.padded // w_s):
+            raise ValueError(
+                f"checkpoint resident bucket {key} has shape "
+                f"{arr.shape}, expected {(rows_s, bs.padded // w_s)} "
+                "(different sync_bucket_mb or worker count?)")
+        filled = sum(size for (_j, _off, size) in bs.items)
+        vecs = _slice_consensus_vectors(arr, filled, saved_slices)
+        if saved_slices != num_slices:
+            if all(np.array_equal(vecs[0], v) for v in vecs[1:]):
+                vecs = [vecs[0]] * max(1, num_slices)
+            else:
+                raise ValueError(
+                    f"checkpoint {path} was saved with "
+                    f"{saved_slices} slice(s) whose consensuses "
+                    f"DIFFER; it cannot re-shard to {num_slices} "
+                    "slice(s) — a per-slice consensus has no defined "
+                    "assignment to a different slice count (restore "
+                    "into the saved topology, or into a replicated "
+                    "layout)")
+        pad = bt.padded - filled
+        tiles = []
+        for vec in vecs:
+            if pad:
+                vec = np.concatenate([vec, np.zeros(pad, vec.dtype)])
+            tiles.append(vec.reshape(w_t, bt.padded // w_t))
+        out[key] = np.concatenate(tiles, axis=0)
+    return out
+
+
 def _relayout_params_residency(path: str, merged: dict, tmpl_flat,
                                *, params_template=None,
-                               bucket_bytes: int | None = None) -> dict:
+                               bucket_bytes: int | None = None,
+                               num_slices: int = 1) -> dict:
     """Re-lay checkpointed params across residency modes (ISSUE 11).
 
     ``merged`` is the host-merged leaf dict; ``tmpl_flat`` the restore
@@ -742,10 +877,29 @@ def _relayout_params_residency(path: str, merged: dict, tmpl_flat,
     tmpl_resident = any(
         jax.tree_util.keystr(p).startswith(".params_resident")
         for p, _t in tmpl_flat)
+    meta = manifest_metadata(path)
+    saved_slices = int(meta.get("num_slices", 1) or 1)
+    meta_mb = meta.get("sync_bucket_mb")
+    meta_bytes = int(float(meta_mb) * (1 << 20)) if meta_mb else None
+    if ckpt_resident and tmpl_resident:
+        # same layout kind — identity unless the slice/worker tiling
+        # changed (ISSUE 13), in which case the consensus vectors
+        # re-pack under the template's tiling
+        same = all(
+            jax.tree_util.keystr(p) in merged
+            and tuple(np.shape(merged[jax.tree_util.keystr(p)]))
+            == tuple(np.shape(t))
+            for p, t in tmpl_flat
+            if jax.tree_util.keystr(p).startswith(".params_resident"))
+        if same and saved_slices == max(1, num_slices):
+            return merged
+        return _relayout_resident_slices(
+            path, merged, tmpl_flat, params_template=params_template,
+            bucket_bytes=(meta_bytes or bucket_bytes
+                          or comms.DEFAULT_BUCKET_BYTES),
+            saved_slices=saved_slices, num_slices=max(1, num_slices))
     if ckpt_resident == tmpl_resident:
         return merged
-    meta_mb = manifest_metadata(path).get("sync_bucket_mb")
-    meta_bytes = int(float(meta_mb) * (1 << 20)) if meta_mb else None
     out = dict(merged)
     if ckpt_resident:
         bb = meta_bytes or bucket_bytes or comms.DEFAULT_BUCKET_BYTES
@@ -757,28 +911,45 @@ def _relayout_params_residency(path: str, merged: dict, tmpl_flat,
                 "the restore template has neither a params tree nor a "
                 "params_resident layout")
         n = int(np.shape(p_items[0][1])[0])
+        if n % max(1, saved_slices):
+            raise ValueError(
+                f"restore template worker rows ({n}) not divisible by "
+                f"the checkpoint's slice count ({saved_slices})")
+        w_s = n // max(1, saved_slices)
         leaves = [jax.ShapeDtypeStruct(tuple(np.shape(t)[1:]),
                                        np.dtype(t.dtype))
                   for _k, t in p_items]
-        for i, b in enumerate(comms.bucket_plan(leaves, n, bb)):
+        # one slot per (leaf, slice): filled below, assembled after
+        slice_rows: list[list] = [[None] * max(1, saved_slices)
+                                  for _ in p_items]
+        for i, b in enumerate(comms.bucket_plan(leaves, w_s, bb)):
             key = f".params_resident['{comms._bucket_name(i)}']"
             if key not in out:
                 raise ValueError(
                     f"checkpoint {path} resident layout has no bucket "
                     f"leaf {key} (saved with a different sync_bucket_mb "
                     "than the manifest records?)")
-            vec = np.asarray(out.pop(key)).reshape(-1)
-            if vec.size != b.padded:
+            arr = np.asarray(out.pop(key))
+            if arr.shape != (n, b.padded // w_s):
                 raise ValueError(
-                    f"checkpoint resident bucket {key} carries "
-                    f"{vec.size} elements, expected {b.padded} "
+                    f"checkpoint resident bucket {key} has shape "
+                    f"{arr.shape}, expected {(n, b.padded // w_s)} "
                     "(different sync_bucket_mb or worker count?)")
+            filled = sum(size for (_j, _off, size) in b.items)
+            vecs = _slice_consensus_vectors(arr, filled, saved_slices)
             for (j, off, size) in b.items:
-                k, t = p_items[j]
-                row = vec[off:off + size].reshape(
-                    np.shape(t)[1:]).astype(np.dtype(t.dtype))
-                # the consensus IS every worker's value
-                out[k] = np.broadcast_to(row[None], np.shape(t)).copy()
+                _k, t = p_items[j]
+                for s, vec in enumerate(vecs):
+                    slice_rows[j][s] = vec[off:off + size].reshape(
+                        np.shape(t)[1:]).astype(np.dtype(t.dtype))
+        for j, (k, t) in enumerate(p_items):
+            # worker (s, i)'s row is ITS slice's consensus — a flat
+            # checkpoint (1 slice) broadcasts the one consensus to
+            # every row, exactly as before
+            rows = np.stack([slice_rows[j][s]
+                             for s in range(max(1, saved_slices))
+                             for _i in range(w_s)])
+            out[k] = np.ascontiguousarray(rows.astype(np.dtype(t.dtype)))
         return out
     bb = bucket_bytes or meta_bytes or comms.DEFAULT_BUCKET_BYTES
     if params_template is None:
@@ -788,7 +959,9 @@ def _relayout_params_residency(path: str, merged: dict, tmpl_flat,
             "engine's per-worker ShapeDtypeStructs) so the resident "
             "bucket layout can be rebuilt")
     pt_flat, pt_def = jax.tree_util.tree_flatten_with_path(params_template)
-    vals, n = [], None
+    s_t = max(1, num_slices)
+    slice_vals: list[list] = []
+    n = None
     for p, _t in pt_flat:
         key = ".params" + jax.tree_util.keystr(p)
         if key not in out:
@@ -797,16 +970,32 @@ def _relayout_params_residency(path: str, merged: dict, tmpl_flat,
                 "build the resident layout (engine config mismatch?)")
         arr = np.asarray(out.pop(key))
         n = int(arr.shape[0])
-        if not np.array_equal(arr, np.broadcast_to(arr[:1], arr.shape)):
+        if n % s_t:
             raise ValueError(
-                f"checkpoint leaf {key} rows differ across workers: only "
-                "a consensus state (weights x equal aggregation) can "
-                "restore into the scatter-resident layout")
-        vals.append(arr[0])
-    resident = comms.resident_from_tree(
-        jax.tree_util.tree_unflatten(pt_def, vals), n, bucket_bytes=bb)
-    for name, rows in resident.items():
-        out[f".params_resident['{name}']"] = rows
+                f"checkpoint worker rows ({n}) not divisible by "
+                f"num_slices ({s_t})")
+        per = n // s_t
+        groups = []
+        for s in range(s_t):
+            g = arr[s * per:(s + 1) * per]
+            if not np.array_equal(g, np.broadcast_to(g[:1], g.shape)):
+                raise ValueError(
+                    f"checkpoint leaf {key} rows differ within slice "
+                    f"{s}: only a consensus state (weights x equal "
+                    "aggregation) can restore into the scatter-resident "
+                    "layout")
+            groups.append(g[0])
+        slice_vals.append(groups)
+    w_t = n // s_t
+    parts = []
+    for s in range(s_t):
+        tree_s = jax.tree_util.tree_unflatten(
+            pt_def, [sv[s] for sv in slice_vals])
+        parts.append(comms.resident_from_tree(tree_s, w_t,
+                                              bucket_bytes=bb))
+    for name in parts[0]:
+        out[f".params_resident['{name}']"] = np.concatenate(
+            [p[name] for p in parts], axis=0)
     return out
 
 

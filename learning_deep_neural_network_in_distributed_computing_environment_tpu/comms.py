@@ -40,7 +40,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .compat import axis_size, optimization_barrier, psum_scatter, shard_map
-from .mesh import DATA_AXIS
+from .mesh import DATA_AXIS, SLICE_AXIS
 
 PyTree = Any
 
@@ -462,7 +462,8 @@ PARAM_RESIDENCIES = ("replicated", "resident")
 
 
 def resident_from_tree(per_worker_tree: PyTree, n: int, *,
-                       bucket_bytes: int = DEFAULT_BUCKET_BYTES) -> dict:
+                       bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+                       n_rows: int | None = None) -> dict:
     """HOST: pack one worker's CONSENSUS params into the resident layout.
 
     ``per_worker_tree`` holds the shared consensus values (equal-blend
@@ -470,9 +471,21 @@ def resident_from_tree(per_worker_tree: PyTree, n: int, *,
     row is the consensus).  Returns ``{bucket: [n, padded // n]}`` numpy
     arrays — row w is worker w's shard.  Used at engine init (broadcast
     init IS a consensus) and by the cross-residency checkpoint/elastic
-    re-layouts."""
+    re-layouts.
+
+    ``n_rows`` (ISSUE 13): the hierarchical mesh stacks S slices of W
+    workers, so the worker axis carries ``n_rows = S x n`` rows while
+    the bucket tiling stays per-INNER-shard (``padded // n``); the one
+    consensus is tiled across the slice groups (a broadcast init, or a
+    global consensus restored from a flat checkpoint, IS every slice's
+    consensus)."""
     import numpy as np
 
+    rows = n_rows or n
+    if rows % n:
+        raise ValueError(
+            f"resident layout rows ({rows}) must be a multiple of the "
+            f"inner shard count ({n})")
     leaves = jax.tree_util.tree_leaves(per_worker_tree)
     out: dict = {}
     for i, b in enumerate(bucket_plan(leaves, n, bucket_bytes)):
@@ -482,7 +495,9 @@ def resident_from_tree(per_worker_tree: PyTree, n: int, *,
         pad = b.padded - vec.size
         if pad:
             vec = np.concatenate([vec, np.zeros(pad, vec.dtype)])
-        out[_bucket_name(i)] = vec.reshape(n, b.padded // n)
+        shards = vec.reshape(n, b.padded // n)
+        out[_bucket_name(i)] = (shards if rows == n
+                                else np.tile(shards, (rows // n, 1)))
     return out
 
 
@@ -793,7 +808,12 @@ def make_resident_gather(mesh, per_worker_template: PyTree, *,
     the engine's enter program shape."""
     from jax.sharding import PartitionSpec as P
 
-    spec = P(DATA_AXIS)
+    from .mesh import stack_axes
+
+    # slice-aware (ISSUE 13): on a hierarchical mesh the rows stack over
+    # (slice, data) and the gather still runs over the inner ``data``
+    # axis only — each slice reconstructs ITS OWN consensus
+    spec = P(stack_axes(mesh))
 
     def _gather(shards):
         def inner(sh):
@@ -1372,6 +1392,419 @@ def gossip_sync(tree: PyTree, *, topology: str, how: str = "equal",
     if poison is not None:
         return synced, res_out, okf
     return synced, res_out
+
+
+# --------------------------------------------------------------------------
+# Hierarchical two-level round sync: inner sharded allreduce over ICI x
+# outer compressed gossip over DCN (ISSUE 13 tentpole)
+# --------------------------------------------------------------------------
+# The paper's topology matrix keeps its engines flat: ONE worker axis,
+# either all-reduced (PR 2's psum_scatter/all_gather program) or gossiped
+# (PR 4's per-bucket ppermute program).  A multi-pod deployment has two
+# very different wires at once — ICI within a slice (fast, low-latency)
+# and DCN between slices (slow, high-latency) — and the production shape
+# (arXiv 2204.06514's multi-pod pjit recipe; arXiv 2412.14374's
+# DCN-traffic hiding) is the COMPOSITION: every slice's W workers
+# all-reduce over ICI, and only the S slice consensuses cross DCN, via
+# gossip hops that can take the compressed int8+EF wire.
+#
+# The decisive layout property: the outer hop rides the 1/W SCATTER
+# SHARD, never the full tree.  The inner psum_scatter already leaves each
+# worker holding its span of the slice SUM; dividing by W makes it the
+# slice mean — worker-invariant within the slice, so worker (s, i) and
+# its counterpart (s', i) in every other slice hold the SAME span of
+# their slices' means.  One ppermute over the ``slice`` axis per bucket
+# therefore gossips the whole slice-mean tree at bucket_bytes / W wire
+# cost per hop, and the trailing inner all_gather distributes the
+# gossip-blended consensus back to every worker of the slice.  DCN bytes
+# per round per worker: hops x padded/W x outer_wire_itemsize per bucket
+# — exactly 1/N_inner of what a flat gossip over the full tree would
+# move (asserted in tests/test_sync.py and bench --entry hier).
+#
+# Semantics ("gossip of means"): g_s = gossip_blend(m_s, m_{s-1}[, m_{s-2}])
+# where m_s is slice s's equal mean.  ``equal`` output is g_s for every
+# worker of slice s; ``weighted`` (the straggler blend, flowing through
+# both levels) keeps the flat form with the gossiped mean standing in
+# for the local one: out_i = w*own_i + (1-w)*(W*g_s - own_i)/(W-1) — the
+# self-exclusive peer mean whose peer pool has been gossip-blended
+# across slices (at S=1 this IS the flat weighted allreduce, the
+# 1-slice-limit contract).  In fp32 the bucketed program is BIT-IDENTICAL
+# to ``aggregate_hier`` below — the same expressions evaluated per leaf
+# from the flat primitives (lax.pmean + the dense gossip blends), i.e.
+# the flat S*W-worker gossip-of-means reference.
+#
+# EF is PER LEVEL: the inner residual keeps its two flat stages (own
+# contribution rounding + W x the gather-payload rounding at the owner's
+# span); a NEW outer residual carries the fp32 rounding of each worker's
+# own outer-hop transmission — the single-stage gossip EF, per slice,
+# on the shard span.  Stage-2 corrections now deliver THROUGH the gossip
+# mixing (next round's mean carries them into the blend), gossip-weighted
+# rather than exact — the usual EF contraction argument still holds, and
+# the fp32 fast path stays bitwise (no EF active).
+
+
+def aggregate_hier(tree: PyTree, *, topology: str, how: str = "equal",
+                   local_weight: float = 0.5,
+                   inner_axis: str = DATA_AXIS,
+                   outer_axis: str = SLICE_AXIS) -> PyTree:
+    """Dense per-leaf hierarchical twin — THE flat gossip-of-means
+    reference the bucketed program is bitwise-gated against.
+
+    Built from the flat engines' own primitives, per leaf, no bucketing
+    or compression: ``lax.pmean`` over the inner (worker) axis is the
+    flat dense slice mean, the ring/double-ring blend expressions over
+    the outer (slice) axis are ``comms.aggregate``'s gossip forms, and
+    the weighted own-term blend is the flat allreduce's.  Must be called
+    inside ``shard_map`` with both axes bound."""
+    if topology not in GOSSIP_HOPS:
+        raise ValueError(
+            f"hierarchical outer topology must be one of "
+            f"{tuple(GOSSIP_HOPS)}, got {topology!r} (an allreduce outer "
+            "level is the flat S*W engine)")
+    if how not in HOWS:
+        raise ValueError(f"how must be one of {HOWS}, got {how!r}")
+    nw = axis_size(inner_axis)
+    ns = axis_size(outer_axis)
+    w = local_weight
+
+    def per_leaf(x: jnp.ndarray) -> jnp.ndarray:
+        m = lax.pmean(x, inner_axis)
+        r1 = _shift(m, ns, 1, outer_axis)
+        if topology == "ring":
+            g = (m + r1) / 2.0 if how == "equal" \
+                else w * m + (1.0 - w) * r1
+        else:
+            r2 = _shift(m, ns, 2, outer_axis)
+            g = (m + r1 + r2) / 3.0 if how == "equal" \
+                else w * m + ((1.0 - w) / 2.0) * (r1 + r2)
+        if how == "equal":
+            return g
+        # the straggler-weighted blend through both levels: the flat
+        # self-exclusive peer-mean form, with the peer pool's mean
+        # gossip-blended across slices (W*g is the blended slice total)
+        return w * x + (1.0 - w) * (nw * g - x) / (nw - 1)
+
+    return jax.tree_util.tree_map(per_leaf, tree)
+
+
+def hier_wire_bytes(tree: PyTree, n_inner: int, *, topology: str,
+                    wire_dtype=None, outer_wire_dtype=None,
+                    bucket_bytes: int = DEFAULT_BUCKET_BYTES) -> dict:
+    """Per-worker bytes SENT by one hierarchical round sync, split by
+    level: ``{"ici": inner_bytes, "dcn": outer_bytes}`` (shapes only —
+    leaves may be arrays or ShapeDtypeStructs).
+
+    - ``ici``: the inner sharded engine, unchanged from the flat
+      accounting — 2(W-1)/W x padded x inner_wire_itemsize per bucket
+      (reduce-scatter + all-gather each move (W-1)/W);
+    - ``dcn``: hops x (padded // W) x outer_wire_itemsize per bucket —
+      the gossip hop rides the 1/W scatter shard, so the outer payload
+      is exactly 1/N_inner of what a flat gossip over the same tree
+      would permute per hop (when the bucket needs no padding; padding
+      rides the wire like everywhere else in the engine).  The int8
+      per-bucket scale scalar is excluded, as in ``sync_wire_bytes``.
+    """
+    leaves = jax.tree_util.tree_leaves(tree)
+    hops = GOSSIP_HOPS.get(topology, 1)
+    if not leaves or n_inner < 1:
+        return {"ici": 0, "dcn": 0}
+    ici = dcn = 0
+    for b in bucket_plan(leaves, n_inner, bucket_bytes):
+        inner_item = (jnp.dtype(wire_dtype).itemsize
+                      if wire_dtype is not None else b.dtype.itemsize)
+        outer_item = (jnp.dtype(outer_wire_dtype).itemsize
+                      if outer_wire_dtype is not None else b.dtype.itemsize)
+        row = b.padded // n_inner
+        ici += 2 * (n_inner - 1) * row * inner_item
+        dcn += hops * row * outer_item
+    return {"ici": ici, "dcn": dcn}
+
+
+def hier_outer_residual_init(per_worker_tree: PyTree, n_inner: int,
+                             n_rows: int, *,
+                             bucket_bytes: int = DEFAULT_BUCKET_BYTES
+                             ) -> dict:
+    """Zero-initialized OUTER-level EF residual, worker-stacked: one
+    ``[n_rows, padded // n_inner]`` fp32 array per sync bucket — row
+    (s*W + i) carries worker (s, i)'s fp32 rounding error of its own
+    outer-hop transmission (its span of slice s's mean), re-injected
+    into the next round's payload exactly like the flat gossip EF."""
+    leaves = jax.tree_util.tree_leaves(per_worker_tree)
+    return {_bucket_name(i): jnp.zeros((n_rows, b.padded // n_inner),
+                                       jnp.float32)
+            for i, b in enumerate(bucket_plan(leaves, n_inner,
+                                              bucket_bytes))}
+
+
+def hierarchical_sync(tree: PyTree, *, topology: str, how: str = "equal",
+                      local_weight: float = 0.5,
+                      inner_axis: str = DATA_AXIS,
+                      outer_axis: str = SLICE_AXIS,
+                      wire_dtype=None, outer_wire_dtype=None,
+                      residual: PyTree | None = None,
+                      outer_residual: dict | None = None,
+                      bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+                      residency: str = "replicated") -> tuple:
+    """One hierarchical round sync (ISSUE 13): bucketed inner
+    reduce-scatter over ``inner_axis`` -> per-bucket outer gossip hop(s)
+    on the 1/W shard over ``outer_axis`` -> apply -> inner all_gather,
+    as one program.  Must be called inside ``shard_map`` with BOTH axes
+    bound.
+
+    ``wire_dtype`` compresses the inner (ICI) collectives exactly like
+    ``sharded_opt_sync``; ``outer_wire_dtype`` independently compresses
+    the outer (DCN) gossip payload (the per-bucket int8 scale ppermutes
+    alongside, decoded with the sender's scale).  ``residual`` is the
+    flat inner EF state (params-shaped, stage 1 + stage 2);
+    ``outer_residual`` the per-level outer EF state ({bucket:
+    [padded // W]} rows, already squeezed inside shard_map) — each
+    enables its level's error feedback independently.
+
+    ``residency="resident"`` (ISSUE 11 composed): the program ENDS at
+    the inner scatter — the first return value is the ``{bucket:
+    [padded // W]}`` decoded post-apply shard of THIS SLICE's consensus
+    (worker-invariant within the slice under the equal blend), and the
+    next round's ``resident_gather`` over the inner axis reconstructs
+    it bit-for-bit.  Scatter-resident state is exactly 1/N_inner per
+    worker between rounds.
+
+    Returns ``(out_or_resident, new_residual, new_outer_residual)``.
+    """
+    if topology not in GOSSIP_HOPS:
+        raise ValueError(
+            f"hierarchical outer topology must be one of "
+            f"{tuple(GOSSIP_HOPS)}, got {topology!r} (an allreduce outer "
+            "level is the flat S*W engine)")
+    if how not in HOWS:
+        raise ValueError(f"how must be one of {HOWS}, got {how!r}")
+    if residency not in PARAM_RESIDENCIES:
+        raise ValueError(
+            f"residency must be one of {PARAM_RESIDENCIES}, got "
+            f"{residency!r}")
+    resident = residency == "resident"
+    if resident and how != "equal":
+        raise ValueError(
+            "a scatter-resident hierarchical output requires the equal "
+            "blend: the weighted own-term makes every worker's output "
+            "per-worker state (config.py resolves weighted to the "
+            "replicated residency)")
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    nw = axis_size(inner_axis)
+    ns = axis_size(outer_axis)
+    if nw < 2:
+        raise ValueError(
+            "the hierarchical sync needs an inner worker axis of size "
+            ">= 2 (the outer gossip rides the 1/W scatter shard; with "
+            "W = 1 there is no inner level — run the flat gossip engine)")
+    if not leaves:
+        return tree, residual, outer_residual
+    res_leaves = None
+    if residual is not None:
+        res_leaves = jax.tree_util.tree_leaves(residual)
+        if len(res_leaves) != len(leaves):
+            raise ValueError(
+                "residual must mirror the synced tree: "
+                f"{len(res_leaves)} leaves vs {len(leaves)}")
+    out: list = [None] * len(leaves)
+    new_res: list | None = [None] * len(leaves) if res_leaves is not None \
+        else None
+    new_outer: dict | None = {} if outer_residual is not None else None
+    resident_out: dict = {}
+    w = local_weight
+    for bi, b in enumerate(bucket_plan(leaves, nw, bucket_bytes)):
+        name = _bucket_name(bi)
+        row = b.padded // nw
+        # ---- pack + inner encode (the flat sharded engine's stage) ----
+        parts, filled = [], 0
+        for (i, _off, size) in b.items:
+            x = leaves[i].astype(jnp.float32).reshape(-1)
+            if res_leaves is not None:
+                x = x + res_leaves[i].astype(jnp.float32).reshape(-1)
+            parts.append(x)
+            filled += size
+        if b.padded > filled:
+            parts.append(jnp.zeros((b.padded - filled,), jnp.float32))
+        buf = jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+        wdt_in = jnp.dtype(wire_dtype) if wire_dtype is not None \
+            else b.dtype
+        quantized_in, encode_in = _wire_codec(wdt_in)
+        sent, sent32, sent_scale = encode_in(buf)
+        if new_res is not None:
+            err = buf - sent32
+        compressed_in = wdt_in != jnp.dtype(jnp.float32)
+        if compressed_in:
+            # compressed reduce-scatter as all-to-all + LOCAL fp32
+            # accumulation (the sharded_opt_sync recipe and rationale)
+            pieces = lax.all_to_all(sent.reshape(nw, row),
+                                    inner_axis, 0, 0)
+            if quantized_in:
+                scales = lax.all_gather(sent_scale, inner_axis)   # [W]
+                shard32 = jnp.sum(pieces.astype(jnp.float32)
+                                  * scales[:, None], axis=0)
+            else:
+                shard32 = jnp.sum(pieces.astype(jnp.float32), axis=0)
+        else:
+            shard32 = psum_scatter(sent, inner_axis, scatter_dimension=0,
+                                   tiled=True).astype(jnp.float32)
+        # ---- the slice mean on the shard: worker-invariant WITHIN the
+        # slice, which is what lets the outer hop ride the shard ----
+        m32 = shard32 / nw
+        # ---- outer gossip hop(s) over the slice axis ----
+        o_send = m32
+        if new_outer is not None:
+            if name not in outer_residual:
+                raise ValueError(
+                    f"outer residual has no bucket {name} (bucket plan "
+                    "/ outer-residual layout mismatch)")
+            o_res = outer_residual[name]
+            if tuple(o_res.shape) != (row,):
+                raise ValueError(
+                    f"outer residual bucket {name} row has shape "
+                    f"{tuple(o_res.shape)}, expected {(row,)} "
+                    "(sync_bucket_mb or worker count changed?)")
+            o_send = m32 + o_res.astype(jnp.float32)
+        wdt_out = jnp.dtype(outer_wire_dtype) \
+            if outer_wire_dtype is not None else b.dtype
+        quantized_out, encode_out = _wire_codec(wdt_out)
+        osent, osent32, osent_scale = encode_out(o_send)
+        if new_outer is not None:
+            # outer-level EF: the fp32 rounding this hop's wire dropped
+            # from THIS worker's transmission rides into next round's
+            # payload (the flat gossip engine's single stage, per level)
+            new_outer[name] = o_send - osent32
+
+        def hop(shift):
+            r = _shift(osent, ns, shift, outer_axis)
+            s = (_shift(osent_scale, ns, shift, outer_axis)
+                 if quantized_out else None)
+            return r, s
+
+        def dec(trip):
+            r, s = trip
+            r32 = r.astype(jnp.float32)
+            return r32 * s if s is not None else r32
+
+        if topology == "ring":
+            r1 = dec(hop(1))
+            g32 = (m32 + r1) / 2.0 if how == "equal" \
+                else w * m32 + (1.0 - w) * r1
+        else:
+            # both shifts issued before either blend term is consumed
+            # (the PR 4 double-ring overlap fence): the shift-2 hop's
+            # DCN time rides under the shift-1 blend
+            h1, h2 = optimization_barrier((hop(1), hop(2)))
+            r1, r2 = dec(h1), dec(h2)
+            g32 = (m32 + r1 + r2) / 3.0 if how == "equal" \
+                else w * m32 + ((1.0 - w) / 2.0) * (r1 + r2)
+
+        # ---- apply on the shard + home gather (inner wire) ----
+        gq, gq_dec, gq_scale = encode_in(g32)
+        if new_res is not None and compressed_in and how == "equal":
+            # stage-2 inner EF (the flat engine's): the gather payload
+            # is wire-quantized every round on the same grid; the span
+            # owner folds W x the rounding error into its residual —
+            # delivery now flows THROUGH next round's mean + gossip
+            # blend (gossip-weighted, one round delayed)
+            e2 = g32 - gq_dec
+            err = err + lax.dynamic_update_slice(
+                jnp.zeros((b.padded,), jnp.float32), nw * e2,
+                (lax.axis_index(inner_axis) * row,))
+
+        def gather_decoded(payload, scale):
+            full = lax.all_gather(payload, inner_axis,
+                                  tiled=True).astype(jnp.float32)
+            if not quantized_in:
+                return full
+            scales = lax.all_gather(scale, inner_axis)           # [W]
+            return (full.reshape(nw, -1) * scales[:, None]).reshape(-1)
+
+        if how == "equal":
+            if resident:
+                # ISSUE 11 composed: the program ends at the scatter —
+                # the decoded shard IS the between-round state, and the
+                # next round's entry gather (over the inner axis)
+                # concatenates exactly these values
+                resident_out[name] = gq_dec
+                full = None
+            else:
+                full = gather_decoded(gq, gq_scale)
+        else:
+            gfull = gather_decoded(gq, gq_scale)
+            own = sent32
+            # the flat weighted form with the gossip-blended peer pool:
+            # W*g is the blended slice total, own excluded as ever
+            full = w * own + (1.0 - w) * (nw * gfull - own) / (nw - 1)
+        for (i, off, size) in b.items:
+            leaf = leaves[i]
+            if full is not None:
+                out[i] = full[off:off + size].reshape(leaf.shape).astype(
+                    leaf.dtype)
+            if new_res is not None:
+                new_res[i] = err[off:off + size].reshape(leaf.shape)
+    res_out = (residual if new_res is None
+               else jax.tree_util.tree_unflatten(treedef, new_res))
+    outer_out = outer_residual if new_outer is None else new_outer
+    first = (resident_out if resident
+             else jax.tree_util.tree_unflatten(treedef, out))
+    return first, res_out, outer_out
+
+
+def make_hier_host_sync(mesh, *, topology: str, how: str = "equal",
+                        local_weight: float = 0.5, wire_dtype=None,
+                        outer_wire_dtype=None,
+                        bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+                        residency: str = "replicated"):
+    """Jitted stand-alone hierarchical round sync over worker-stacked
+    pytrees (tests / bench A/Bs) — the two-level twin of
+    ``make_host_sync``.  Leaves carry a leading worker axis of size
+    S x W sharded over ``(slice, data)`` (slice-major rows).  Returns
+    ``run(tree, residual=None, outer_residual=None)`` ->
+    ``(out_or_resident, new_residual, new_outer_residual)``."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P((SLICE_AXIS, DATA_AXIS))
+
+    def _sync(tree, residual, outer_res):
+        def inner(shard, res, ores):
+            sq = lambda t: jax.tree_util.tree_map(lambda x: x[0], t)
+            ex = lambda t: jax.tree_util.tree_map(lambda x: x[None], t)
+            outs = hierarchical_sync(
+                sq(shard), topology=topology, how=how,
+                local_weight=local_weight, wire_dtype=wire_dtype,
+                outer_wire_dtype=outer_wire_dtype, residual=sq(res),
+                outer_residual=sq(ores), bucket_bytes=bucket_bytes,
+                residency=residency)
+            return tuple(ex(o) for o in outs)
+        return shard_map(inner, mesh=mesh, in_specs=(spec,) * 3,
+                         out_specs=(spec,) * 3)(tree, residual, outer_res)
+
+    jitted = jax.jit(_sync)
+
+    def run(tree, residual=None, outer_residual=None):
+        return jitted(tree, residual, outer_residual)
+
+    return run
+
+
+def make_hier_host_aggregator(mesh, *, topology: str, how: str = "equal",
+                              local_weight: float = 0.5):
+    """Jitted stand-alone DENSE hierarchical aggregator — the flat
+    gossip-of-means reference program (``aggregate_hier`` per leaf) the
+    bucketed engine is bitwise-gated against in fp32."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P((SLICE_AXIS, DATA_AXIS))
+
+    def _agg(tree):
+        def inner(shard):
+            squeezed = jax.tree_util.tree_map(lambda x: x[0], shard)
+            out = aggregate_hier(squeezed, topology=topology, how=how,
+                                 local_weight=local_weight)
+            return jax.tree_util.tree_map(lambda x: x[None], out)
+        return shard_map(
+            inner, mesh=mesh, in_specs=(spec,), out_specs=spec)(tree)
+
+    return jax.jit(_agg)
 
 
 def make_host_sync(mesh, *, mode: str = "sharded", how: str = "equal",

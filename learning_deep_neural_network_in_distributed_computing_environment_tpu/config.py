@@ -174,6 +174,29 @@ class Config:
     sync_compression: str = "none"   # none | ef
     # Sharded-sync bucket size (MiB of fp32 parameters per collective).
     sync_bucket_mb: float = 4.0
+    # --- hierarchical two-level sync (ISSUE 13) ----------------------------
+    # num_slices: outer slice count of the two-level worker grid.  1 (the
+    # default) is the flat world — every code path is EXACTLY the
+    # pre-ISSUE-13 engine (no slice mesh axis is ever built).  S > 1
+    # composes the two sync engines the paper's topology matrix keeps
+    # separate: each slice's W workers all-reduce over the ICI-shaped
+    # ``data`` axis via the bucketed psum_scatter/all_gather engine
+    # (inner level), and the S slice consensuses gossip over the
+    # DCN-shaped ``slice`` axis via per-bucket ppermute hops (outer
+    # level, --topology ring | double_ring) — one donated shard_map
+    # program over the nested axes, with the outer hop riding each
+    # worker's 1/W scatter shard so DCN wire bytes are bucket/W per hop.
+    # v1 composition limits (rejected eagerly, documented in
+    # docs/ARCHITECTURE.md): outer allreduce (that is the flat S*W
+    # engine — use --num_slices 1), a dense inner level, inner model
+    # axes (TP/PP/SP/EP/FSDP), elastic membership / --chaos faults, and
+    # explicit buddy redundancy.
+    num_slices: int = 1
+    # Wire dtype of the OUTER (DCN) gossip hops; "" inherits --sync_dtype.
+    # The production shape compresses the slow inter-slice wire (int8 +
+    # EF) while the fast ICI level stays fp32 — exactly the per-level
+    # resolution the DCN/ICI split exists for.
+    sync_dtype_outer: str = ""
     # --- shard-resident optimizer placement (ISSUE 9) ----------------------
     # opt_placement: where the round-boundary optimizer transform (the
     # FedAvg blend + EF bookkeeping, and in gradients mode the round-level
@@ -346,7 +369,13 @@ class Config:
                 "scale-then-encode apply onto the 1/N shard (the sharded "
                 "placement) — a post-gather replicated apply would gather "
                 "the uncompressed fp32 sum instead")
-        if self.param_residency == "resident" and self.topology != "allreduce":
+        if (self.param_residency == "resident"
+                and self.topology != "allreduce" and self.num_slices == 1):
+            # hierarchical runs (num_slices > 1) are exempt: there the
+            # ring/double_ring topology names the OUTER slice level and
+            # the between-round state is each slice's consensus — a
+            # worker-invariant-within-slice tree whose 1/W scatter shard
+            # CAN stay resident (resolve_param_residency)
             raise ValueError(
                 f"--param_residency resident cannot combine with "
                 f"--topology {self.topology}: gossip blends are "
@@ -370,7 +399,7 @@ class Config:
                 "state; --opt_placement replicated applies post-gather "
                 "full-size and leaves no per-shard apply output to keep "
                 "resident")
-        if self.shard_redundancy == "buddy" and (
+        if self.shard_redundancy == "buddy" and self.num_slices == 1 and (
                 self.topology != "allreduce" or self.sync_mode == "dense"):
             raise ValueError(
                 "--shard_redundancy buddy protects SHARD-RESIDENT state "
@@ -381,10 +410,63 @@ class Config:
                 "replicated — nothing is uniquely held, so there is "
                 "nothing for a buddy to back up (auto resolves this to "
                 "off)")
-        if self.sync_compression == "ef" and not compressed_wire:
+        # --- hierarchical two-level sync (ISSUE 13): eager v1 limits ----
+        _choices("sync_dtype_outer", self.sync_dtype_outer,
+                 ("", "float32", "bfloat16", "int8"))
+        if self.num_slices < 1:
+            raise ValueError(
+                f"num_slices must be >= 1, got {self.num_slices}")
+        if self.sync_dtype_outer and self.num_slices == 1:
+            raise ValueError(
+                "--sync_dtype_outer sets the OUTER (DCN) gossip wire of "
+                "the hierarchical sync; it requires --num_slices >= 2 "
+                "(a flat run has no outer level)")
+        outer_compressed = (self.sync_dtype_outer or self.sync_dtype) in (
+            "bfloat16", "int8")
+        if self.num_slices > 1:
+            if self.topology == "allreduce":
+                raise ValueError(
+                    "--num_slices > 1 syncs the outer slice level with "
+                    "the ppermute GOSSIP engine (--topology ring | "
+                    "double_ring); an allreduce outer level is just the "
+                    "flat sharded allreduce over all S*W workers — run "
+                    "it as --num_slices 1")
+            if self.sync_mode == "dense":
+                raise ValueError(
+                    "--num_slices > 1 runs the bucketed sharded "
+                    "psum_scatter/all_gather engine on the inner (ICI) "
+                    "level — the outer gossip hop rides its 1/W scatter "
+                    "shard; a dense inner level has no shard for the "
+                    "hop to ride (--sync_mode dense rejected)")
+            if self.chaos:
+                raise ValueError(
+                    "--chaos cannot combine with --num_slices > 1 in "
+                    "v1: elastic membership and the crash/NaN fault "
+                    "machinery operate on the flat worker axis (mesh "
+                    "resize, ring buddy map, quorum floor are all "
+                    "single-level) — per-slice membership is the "
+                    "ROADMAP follow-on")
+            if self.shard_redundancy == "buddy":
+                raise ValueError(
+                    "--shard_redundancy buddy cannot combine with "
+                    "--num_slices > 1 in v1: the buddy map is the flat "
+                    "worker-axis ring, and crash recovery (its consumer) "
+                    "is rejected under slices anyway (auto resolves to "
+                    "off)")
+            if self.opt_placement == "replicated":
+                raise ValueError(
+                    "--opt_placement replicated cannot combine with "
+                    "--num_slices > 1: the outer gossip hop rides the "
+                    "1/W scatter shard, so the apply (inner mean scale, "
+                    "gossip blend, wire encode) necessarily runs "
+                    "shard-side — there is no post-gather full-size "
+                    "apply stage in the hierarchical program")
+        if self.sync_compression == "ef" and not (compressed_wire
+                                                  or outer_compressed):
             raise ValueError(
                 "--sync_compression ef compensates compressed-wire "
-                "rounding; it requires --sync_dtype bfloat16 or int8")
+                "rounding; it requires a compressed --sync_dtype (or, "
+                "hierarchically, --sync_dtype_outer) of bfloat16 or int8")
         if self.checkpoint_every < 0:
             raise ValueError(
                 f"checkpoint_every must be >= 0, got {self.checkpoint_every}")
@@ -451,7 +533,7 @@ class Config:
 
     def resolve_sync_mode(self, backend: str) -> str:
         """Resolve ``--sync_mode`` per topology into the engine actually
-        run: ``dense`` | ``sharded`` | ``gossip``.
+        run: ``dense`` | ``sharded`` | ``gossip`` | ``hier``.
 
         ``sharded`` names the bucketed fast engine, whatever the
         topology: the reduce-scatter/all-gather program for allreduce,
@@ -461,7 +543,17 @@ class Config:
         bucketed collectives ride the ICI ring — and whenever a
         compressed wire is requested (compression is a bucketed-engine
         feature); the XLA:CPU test backend keeps the dense twin, which
-        is bit-identical in fp32 anyway."""
+        is bit-identical in fp32 anyway.
+
+        ``--num_slices > 1`` resolves to ``hier`` unconditionally
+        (ISSUE 13): the hierarchical program IS the composition of the
+        two fast engines — sharded allreduce on the inner (ICI) level,
+        ppermute gossip on the outer (DCN) level — so there is no dense
+        or per-level-auto variant to fall back to (the unsupported
+        level pairs were rejected eagerly at construction; see
+        ``resolve_sync_levels`` for the per-level breakdown)."""
+        if self.num_slices > 1:
+            return "hier"
         fast = "sharded" if self.topology == "allreduce" else "gossip"
         if self.sync_mode == "sharded":
             return fast
@@ -481,6 +573,30 @@ class Config:
             return fast
         return fast if backend == "tpu" else "dense"
 
+    def resolve_sync_levels(self, backend: str) -> dict:
+        """Per-LEVEL engine resolution (ISSUE 13): ``{"inner": ...,
+        "outer": ...}``.
+
+        Flat runs report their single resolved engine as the inner
+        level with ``outer=None``.  Hierarchical runs are always
+        ``inner="sharded"`` (the bucketed psum_scatter/all_gather
+        engine over the ICI-shaped ``data`` axis) x ``outer="gossip"``
+        (per-bucket ppermute hops over the DCN-shaped ``slice`` axis,
+        ``--topology`` picking ring vs double_ring) — every other pair
+        (gossip-outer x dense-inner, allreduce-outer, ...) was rejected
+        eagerly at Config construction, so this resolution can never
+        surprise at round time."""
+        if self.num_slices == 1:
+            return {"inner": self.resolve_sync_mode(backend),
+                    "outer": None}
+        return {"inner": "sharded", "outer": "gossip"}
+
+    def resolve_sync_wire_dtypes(self) -> tuple[str, str]:
+        """``(inner, outer)`` wire dtype names: ``--sync_dtype`` for the
+        inner (ICI) collectives, ``--sync_dtype_outer`` for the outer
+        (DCN) gossip hops, inheriting the inner choice when unset."""
+        return (self.sync_dtype, self.sync_dtype_outer or self.sync_dtype)
+
     def resolve_opt_placement(self, backend: str) -> str:
         """Resolve ``--opt_placement`` into the placement actually run:
         ``replicated`` | ``sharded`` | ``local``.
@@ -499,6 +615,12 @@ class Config:
         scatter/gather phases to place an apply between and reports
         ``replicated`` (which its arithmetic literally is)."""
         mode = self.resolve_sync_mode(backend)
+        if mode == "hier":
+            # the hierarchical apply (inner mean scale, outer gossip
+            # blend, wire encode) necessarily runs on the 1/W scatter
+            # shard — the outer hop rides it; explicit replicated was
+            # rejected eagerly at construction
+            return "sharded"
         if mode == "gossip" or self.topology != "allreduce":
             return "local"
         if self.opt_placement in ("replicated", "sharded"):
@@ -533,8 +655,15 @@ class Config:
         ``auto`` picks resident exactly when all three hold; an explicit
         ``resident`` under weighted/gradients resolves to replicated with
         an engine log line, mirroring ``--opt_placement sharded`` on a
-        gossip topology."""
-        if self.resolve_sync_mode(backend) != "sharded":
+        gossip topology.
+
+        Hierarchical runs (ISSUE 13) qualify like the flat sharded
+        engine: the between-round state is each SLICE's consensus —
+        worker-invariant within the slice under weights x equal — and
+        the sync still ends at the inner scatter, so each worker keeps
+        its 1/W bucket shard of its own slice's consensus (exactly
+        1/N_inner between rounds, the ISSUE 13 composition contract)."""
+        if self.resolve_sync_mode(backend) not in ("sharded", "hier"):
             return "replicated"
         if self.resolve_opt_placement(backend) != "sharded":
             # the resident state IS the shard-side apply output; an
@@ -596,6 +725,13 @@ class Config:
         """Parse ``mesh_shape`` into an ordered {axis: size} dict.
 
         A size of -1 means "all remaining devices" (resolved in mesh.py).
+        ``--num_slices > 1`` (ISSUE 13) prepends the ``slice`` outer
+        axis — it LEADS the mesh so multi-host layouts map whole slices
+        to whole host groups (only the outer gossip hop crosses DCN).
+        The slice axis comes from ``--num_slices`` only; naming it in
+        ``--mesh_shape`` is rejected, as are inner model axes under
+        slices (the v1 composition limit: hierarchical sync x
+        TP/PP/SP/EP/FSDP needs per-device bucket plans — follow-on).
         """
         axes: dict[str, int] = {}
         for part in self.mesh_shape.split(","):
@@ -604,8 +740,23 @@ class Config:
                 continue
             name, _, size = part.partition("=")
             axes[name.strip()] = int(size) if size else -1
+        if "slice" in axes:
+            raise ValueError(
+                "the 'slice' mesh axis is driven by --num_slices, not "
+                f"--mesh_shape (got --mesh_shape {self.mesh_shape!r})")
         if "data" not in axes:
             axes = {"data": -1, **axes}
+        if self.num_slices > 1:
+            inner = [a for a, s in axes.items()
+                     if a != "data" and (s > 1 or s <= 0)]
+            if inner:
+                raise ValueError(
+                    f"--num_slices {self.num_slices} cannot combine with "
+                    f"inner mesh axes {inner} in v1: the hierarchical "
+                    "sync's bucket plan is per-worker, and TP/PP/SP/EP/"
+                    "FSDP shard the parameter leaves themselves "
+                    "(docs/ARCHITECTURE.md documents the demotion)")
+            axes = {"slice": self.num_slices, **axes}
         return axes
 
 
@@ -656,7 +807,10 @@ def build_argparser() -> argparse.ArgumentParser:
     # Framework knobs
     p.add_argument("--model", type=str, default=d.model)
     p.add_argument("--dataset", type=str, default=d.dataset)
-    p.add_argument("--num_workers", type=int, default=d.num_workers)
+    p.add_argument("--num_workers", type=int, default=d.num_workers,
+                   help="data-axis worker count (0 = all devices); under "
+                        "--num_slices > 1 this is workers PER SLICE (the "
+                        "inner ICI level) — the total is slices x this")
     p.add_argument("--seed", type=int, default=d.seed)
     p.add_argument("--device", type=str, default=None,
                    help="tpu|cpu — force a JAX platform (default: auto)")
@@ -767,6 +921,21 @@ def build_argparser() -> argparse.ArgumentParser:
                         "aggregation)")
     p.add_argument("--sync_bucket_mb", type=float, default=d.sync_bucket_mb,
                    help="sharded-sync bucket size in MiB per collective")
+    p.add_argument("--num_slices", type=int, default=d.num_slices,
+                   help="hierarchical two-level sync: outer slice count "
+                        "of the (slice, worker) grid — each slice's "
+                        "workers all-reduce over ICI (bucketed "
+                        "psum_scatter/all_gather) and the slice "
+                        "consensuses gossip over DCN (--topology ring | "
+                        "double_ring, per-bucket ppermute on the 1/W "
+                        "scatter shard); 1 = the flat engine")
+    p.add_argument("--sync_dtype_outer", type=str,
+                   default=d.sync_dtype_outer,
+                   choices=["", "float32", "bfloat16", "int8"],
+                   help="wire dtype of the OUTER (DCN) gossip hops "
+                        "(hierarchical runs; '' inherits --sync_dtype — "
+                        "the production shape compresses the slow "
+                        "inter-slice wire while ICI stays fp32)")
     p.add_argument("--opt_placement", type=str, default=d.opt_placement,
                    choices=["auto", "replicated", "sharded"],
                    help="round-boundary optimizer placement (ZeRO-1 "

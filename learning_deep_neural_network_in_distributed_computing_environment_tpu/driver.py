@@ -52,8 +52,8 @@ from .data import (
 )
 from . import checkpoint as ckpt_lib
 from .mesh import (DATA_AXIS, EXPERT_AXIS, MODEL_AXIS, PIPE_AXIS,
-                   build_mesh, initialize_distributed, max_data_axis_size,
-                   resize_data_axis)
+                   SLICE_AXIS, build_mesh, initialize_distributed,
+                   max_data_axis_size, resize_data_axis, world_size)
 from .models import get_model, is_attention_model, is_token_model
 from .train import LocalSGDEngine, rank0_variables
 
@@ -158,7 +158,13 @@ def checkpoint_metadata(cfg: Config, num_classes: int,
             "param_residency": (param_residency
                                 or cfg.resolve_param_residency(
                                     jax.default_backend())),
-            "sync_bucket_mb": float(cfg.sync_bucket_mb)}
+            "sync_bucket_mb": float(cfg.sync_bucket_mb),
+            # slice topology (ISSUE 13): restore re-lays resident bucket
+            # rows out across slice counts (checkpoint.py) and a
+            # hierarchical state's per-slice consensus is refused where
+            # a global one is required — the manifest must say which
+            # world wrote it
+            "num_slices": int(cfg.num_slices)}
     if params_template is not None:
         # per-worker params leaf shapes (ISSUE 12 satellite): a
         # scatter-resident checkpoint's 1/N bucket rows carry no leaf
@@ -306,6 +312,12 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         cfg.time_limit, cfg.chaos_grace, cfg.chaos_retries,
         cfg.chaos_backoff) if schedule is not None else None)
     elastic_on = schedule is not None or elastic_snapshot is not None
+    if cfg.num_slices > 1 and elastic_snapshot is not None:
+        raise ValueError(
+            "elastic_snapshot cannot combine with --num_slices > 1 in "
+            "v1: membership snapshots describe the flat worker axis "
+            "(--chaos is likewise rejected at config time) — per-slice "
+            "membership is the ROADMAP follow-on")
     if mesh is None:
         axes = cfg.mesh_axes()
         if cfg.num_workers:
@@ -318,7 +330,16 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         # the caller's mesh predates the membership change; rebuild the
         # data axis exactly as the in-process transition does
         mesh = resize_data_axis(mesh, elastic_snapshot.n_workers)
-    n = mesh.shape[DATA_AXIS]
+    if int(mesh.shape.get(SLICE_AXIS, 1)) != cfg.num_slices:
+        raise ValueError(
+            f"mesh slice axis ({int(mesh.shape.get(SLICE_AXIS, 1))}) "
+            f"does not match --num_slices {cfg.num_slices}: the "
+            "hierarchical sync resolution is config-driven — build the "
+            "mesh from cfg.mesh_axes() (or pass none and let the driver)")
+    # TOTAL worker count — slices x workers-per-slice on a hierarchical
+    # mesh (ISSUE 13); every partition, pack, metric row, and RNG stream
+    # below is per total worker, exactly as before at 1 slice
+    n = world_size(mesh)
     if jax.process_count() > 1 and n % jax.process_count():
         # validate once at setup: probe-duration and wall-time attribution
         # both need whole worker-row blocks per process (probe.py,
@@ -679,9 +700,10 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     # ring/double_ring, legacy per-leaf dense otherwise — surfaced here
     # (and as results["sync_engine"]) so a run artifact states which sync
     # program produced it
-    log.info("round-sync engine: %s (topology=%s, wire=%s, "
-             "param_residency=%s)",
+    log.info("round-sync engine: %s (topology=%s, wire=%s/%s, "
+             "num_slices=%d, param_residency=%s)",
              engine.sync_mode, cfg.topology, cfg.sync_dtype,
+             cfg.sync_dtype_outer or cfg.sync_dtype, cfg.num_slices,
              engine.param_residency)
     sample = trainset.images[:batch]
     if elastic_snapshot is None:
@@ -760,7 +782,8 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             # place that owns the invariant); re-derive + restage after
             state, start_epoch = ckpt_lib.restore_checkpoint(
                 latest, state, params_template=engine.params_template,
-                bucket_bytes=engine.sync_bucket_bytes)
+                bucket_bytes=engine.sync_bucket_bytes,
+                num_slices=engine.n_slices)
             state = engine.refresh_buddy(state)
             log.info("resumed from %s at global epoch %d", latest, start_epoch)
 
@@ -828,6 +851,14 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         # number, not a claim ("mode" keeps the pre-ISSUE-9 string)
         "sync_engine": {
             "mode": engine.sync_mode,
+            # per-LEVEL resolution (ISSUE 13): inner = the ICI engine,
+            # outer = the DCN engine (None on flat runs) — plus the
+            # static per-round wire-byte split, filled after the first
+            # round arms the accounting (zeros when no round ran)
+            "levels": cfg.resolve_sync_levels(jax.default_backend()),
+            "num_slices": engine.n_slices,
+            "sync_bytes_ici": 0,
+            "sync_bytes_dcn": 0,
             "opt_placement": engine.opt_placement,
             # the ENGINE-resolved residency (ISSUE 11): the config
             # resolution plus the inner-axes / 1-worker demotions — what
@@ -1726,6 +1757,14 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     results["checkpoint"] = (ckpt_engine.summary()
                              if ckpt_engine is not None
                              else {"enabled": False})
+
+    # per-level wire-byte telemetry (ISSUE 13): the engine computed the
+    # split when the first round armed the accounting (zeros when no
+    # round ran) — possibly a post-elastic engine, whose split reflects
+    # the final membership like per_worker_state_bytes does
+    ici_b, dcn_b = engine._sync_bytes_split
+    results["sync_engine"]["sync_bytes_ici"] = ici_b
+    results["sync_engine"]["sync_bytes_dcn"] = dcn_b
 
     # sanitizer provenance (ISSUE 6): recorded like sync_engine — every
     # run artifact states whether it ran sanitized and what the harness

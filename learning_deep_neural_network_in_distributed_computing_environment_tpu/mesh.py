@@ -31,6 +31,14 @@ PIPE_AXIS = "pipe"
 SEQ_AXIS = "seq"
 EXPERT_AXIS = "expert"
 FSDP_AXIS = "fsdp"
+# Hierarchical two-level sync (ISSUE 13): the outer product of the worker
+# grid.  ``slice`` positions are DCN-shaped (high-latency inter-pod links
+# — synced by the compressed ppermute gossip engine), while the ``data``
+# axis within each slice is ICI-shaped (the sharded psum_scatter /
+# all_gather engine).  The axis leads the mesh so multi-host layouts put
+# whole slices on whole host groups and only the once-per-round gossip
+# hop crosses DCN — the pjit/TPUv4 multi-pod recipe (PAPERS.md).
+SLICE_AXIS = "slice"
 
 
 def initialize_distributed() -> None:
@@ -115,7 +123,14 @@ def build_mesh(axes: dict[str, int] | None = None,
 def max_data_axis_size(mesh: Mesh) -> int:
     """Device-capacity ceiling for the elastic data axis: how many worker
     positions the available devices can host given the mesh's inner
-    (non-data) axes.  A join past this is rejected, not crashed on."""
+    (non-data) axes.  A join past this is rejected, not crashed on.
+
+    Slice-aware (ISSUE 13): the ``slice`` outer axis consumes devices
+    exactly like the inner model axes do — on an S-slice mesh the data
+    axis can grow only to ``devices // (S x inner)`` workers PER SLICE
+    (membership changes under ``--num_slices > 1`` are rejected up
+    front in v1, but the capacity arithmetic must already be honest for
+    the telemetry and the eventual per-slice elastic follow-on)."""
     inner = math.prod(int(s) for a, s in mesh.shape.items()
                       if a != DATA_AXIS)
     return len(jax.devices()) // max(1, inner)
@@ -153,5 +168,22 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def num_slices(mesh: Mesh) -> int:
+    """Outer slice count of a hierarchical mesh (1 = the flat world)."""
+    return int(mesh.shape.get(SLICE_AXIS, 1))
+
+
+def stack_axes(mesh: Mesh) -> tuple[str, ...] | str:
+    """The mesh axes a worker-stacked [N, ...] leading dim shards over:
+    ``(slice, data)`` on a hierarchical mesh (slice-major, so rows
+    ``s*W .. s*W+W-1`` are slice ``s``'s workers), plain ``data``
+    otherwise — a PartitionSpec entry either way."""
+    if num_slices(mesh) > 1:
+        return (SLICE_AXIS, DATA_AXIS)
+    return DATA_AXIS
+
+
 def world_size(mesh: Mesh) -> int:
-    return mesh.shape[DATA_AXIS]
+    """TOTAL worker count: slices x workers-per-slice (flat: the data
+    axis alone — unchanged meaning at ``--num_slices 1``)."""
+    return mesh.shape[DATA_AXIS] * num_slices(mesh)

@@ -89,6 +89,33 @@ def gather_durations(local_duration: float, world_size: int,
     return np.full(world_size, local_duration, np.float64)
 
 
+def attribute_sync_wall(sync_ms: float, ici_bytes: int, dcn_bytes: int,
+                        dcn_cost_factor: float = 1.0
+                        ) -> tuple[float, float]:
+    """Split one measured sync wall across the two interconnect levels
+    (ISSUE 13): ``(ici_ms, dcn_ms)``.
+
+    The round loop measures ONE wall for the whole fused/standalone sync
+    program — the two levels execute inside a single XLA program and
+    cannot be timed separately from the host.  This attribution is a
+    declared MODEL, not a measurement: the wall splits proportionally to
+    each level's wire bytes, with ``dcn_cost_factor`` weighting a DCN
+    byte's relative cost (1.0 on CPU where both "wires" are local
+    memcpys — the honest default the tests pin; a real multi-pod
+    deployment calibrates it from the measured DCN/ICI bandwidth ratio,
+    the ROADMAP real-TPU follow-on).  The per-level walls feed the same
+    telemetry rows (``sync_ms_ici`` / ``sync_ms_dcn``) and, on
+    heterogeneous fleets, the straggler EMA's view of where a slow
+    round's time went.  Flat rounds (zero DCN bytes) attribute the whole
+    wall to the ICI level — the schema is identical on every engine."""
+    total = float(ici_bytes) + float(dcn_bytes) * float(dcn_cost_factor)
+    if total <= 0 or sync_ms <= 0:
+        return (round(float(sync_ms), 3), 0.0)
+    dcn_ms = float(sync_ms) * (float(dcn_bytes) * float(dcn_cost_factor)
+                               / total)
+    return (round(float(sync_ms) - dcn_ms, 3), round(dcn_ms, 3))
+
+
 def joiner_sec_per_batch(survivor_spb: np.ndarray,
                          mode: str = "mean") -> float:
     """Probe-EMA seed for a worker JOINING mid-run (ISSUE 8).

@@ -239,6 +239,19 @@ def load_params_resident(path: str, meta: dict, sharding=None) -> dict:
     from .. import comms
     bucket_bytes = max(1, int(float(meta.get("sync_bucket_mb", 4.0))
                               * (1 << 20)))
+    n_slices = int(meta.get("num_slices", 1) or 1)
+    if n_slices > 1:
+        # hierarchical checkpoint (ISSUE 13): rows stack S slices of W
+        # inner shards and each SLICE has its own consensus — serve
+        # takes slice 0's (rows 0..W-1), the same rank-0 convention the
+        # training engine's final eval uses
+        rows = int(next(iter(resident.values())).shape[0])
+        if rows % n_slices:
+            raise ValueError(
+                f"checkpoint {path}: resident rows ({rows}) not "
+                f"divisible by the manifest's num_slices ({n_slices})")
+        w = rows // n_slices
+        resident = {k: v[:w] for k, v in resident.items()}
     tree = comms.resident_to_tree(resident, template,
                                   bucket_bytes=bucket_bytes)
     return jax.tree_util.tree_map(

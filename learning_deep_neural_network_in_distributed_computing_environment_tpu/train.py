@@ -60,7 +60,7 @@ from .compat import (LEGACY_SHARD_MAP, axis_size, optimization_barrier,
                      pcast, shard_map, typeof)
 from .config import Config
 from .data.augment import augment_batch
-from .mesh import DATA_AXIS
+from .mesh import DATA_AXIS, SLICE_AXIS
 
 log = logging.getLogger(__name__)
 PyTree = Any
@@ -118,6 +118,16 @@ class TrainState(struct.PyTreeNode):
     # engine program (the round program is handed the state without it;
     # the sync program writes the fresh copy).
     buddy: PyTree = None
+    # OUTER-level EF residual of the hierarchical sync (ISSUE 13;
+    # ``--num_slices > 1`` with a compressed ``--sync_dtype_outer`` and
+    # ``--sync_compression ef``; None otherwise).  One fp32
+    # ``[N_total, padded // W]`` array per sync bucket: row (s*W + i)
+    # carries worker (s, i)'s rounding error of its own DCN gossip
+    # transmission (its 1/W span of slice s's mean), re-injected into
+    # the next round's outer payload — the flat gossip engine's
+    # single-stage EF, per level (comms.hierarchical_sync).  The INNER
+    # level keeps its flat two-stage residual in ``sync_residual``.
+    sync_residual_outer: PyTree = None
 
 
 def _first_worker_row(x):
@@ -165,24 +175,35 @@ def _host_fetch(tree):
 
 
 def resident_consensus(state: "TrainState", params_template,
-                       bucket_bytes: int | None = None) -> PyTree:
+                       bucket_bytes: int | None = None,
+                       n_inner: int | None = None) -> PyTree:
     """HOST per-worker consensus params of a scatter-resident state —
     the host twin of the round-entry gather (concatenating the shard
     rows is bit-exact data movement).  THE one reconstruction path:
     ``rank0_variables`` and ``LocalSGDEngine.materialize_params`` both
-    route through it."""
+    route through it.
+
+    ``n_inner`` (ISSUE 13): on a hierarchical state the rows stack S
+    slices of W inner shards and each SLICE has its own consensus —
+    the rank-0 consumer takes slice 0's (rows 0..W-1), matching the
+    replicated path's worker-0-row convention."""
     if params_template is None:
         raise ValueError(
             "state carries scatter-resident params (params_resident): "
             "pass params_template/bucket_bytes or use "
             "LocalSGDEngine.rank0_variables / materialize_params")
+    resident = _host_fetch(state.params_resident)
+    if n_inner:
+        resident = {k: np.asarray(v)[:n_inner]
+                    for k, v in resident.items()}
     return comms.resident_to_tree(
-        _host_fetch(state.params_resident), params_template,
+        resident, params_template,
         bucket_bytes=bucket_bytes or comms.DEFAULT_BUCKET_BYTES)
 
 
 def rank0_variables(state: "TrainState", *, params_template=None,
-                    bucket_bytes: int | None = None) -> dict:
+                    bucket_bytes: int | None = None,
+                    n_inner: int | None = None) -> dict:
     """Worker-0 slice of a stacked TrainState as model.apply variables —
     the reference's rank-0 model for test evaluation (main.py:61-62).
 
@@ -194,8 +215,9 @@ def rank0_variables(state: "TrainState", *, params_template=None,
     passes them for you)."""
     if state.params is None:
         # the consensus IS every worker's value — no row slice needed
+        # (hierarchical states: slice 0's consensus, via n_inner)
         variables = {"params": resident_consensus(
-            state, params_template, bucket_bytes)}
+            state, params_template, bucket_bytes, n_inner)}
     else:
         variables = {"params": jax.tree_util.tree_map(_first_worker_row,
                                                       state.params)}
@@ -412,7 +434,22 @@ class LocalSGDEngine:
         #                                 identical parameter structure)
         self.mesh = mesh
         self.cfg = cfg
-        self.n_workers = mesh.shape[DATA_AXIS]
+        # hierarchical two-level mesh (ISSUE 13): the worker grid is the
+        # (slice, data) outer product — ``n_inner`` workers per slice on
+        # the ICI-shaped data axis, ``n_slices`` slices on the DCN-shaped
+        # outer axis, ``n_workers`` the TOTAL (its pre-ISSUE-13 meaning
+        # at 1 slice: every metric array, pack, partition, and RNG
+        # stream is per total worker).  At --num_slices 1 nothing below
+        # changes: no slice axis exists and every spec/collective keeps
+        # its flat form bit-for-bit.
+        self.n_slices = int(mesh.shape.get(SLICE_AXIS, 1))
+        self.slice_axis = SLICE_AXIS if self.n_slices > 1 else None
+        self.n_inner = mesh.shape[DATA_AXIS]
+        self.n_workers = self.n_inner * self.n_slices
+        # the worker-stack leading axis: (slice, data) on a hierarchical
+        # mesh (slice-major rows), plain data otherwise
+        self._stack_axes = ((SLICE_AXIS, DATA_AXIS)
+                            if self.slice_axis else (DATA_AXIS,))
         from .mesh import FSDP_AXIS, SEQ_AXIS
         self.seq_axis = (
             SEQ_AXIS if (cfg.sequence_parallel != "none"
@@ -445,10 +482,12 @@ class LocalSGDEngine:
         self.param_specs = None      # set by init_state
         self._sspec = None           # full TrainState spec tree (TP only)
         # inner (non-worker) mesh axes of size > 1 — the axes legacy
-        # shard_map's replication certifier may need help with
+        # shard_map's replication certifier may need help with (the
+        # slice axis is a worker-grid axis, not a model axis: values
+        # vary over it, nothing is replication-certified along it)
         self._inner_axes = tuple(
             a for a in mesh.axis_names
-            if a != DATA_AXIS and int(mesh.shape[a]) > 1)
+            if a not in (DATA_AXIS, SLICE_AXIS) and int(mesh.shape[a]) > 1)
         # Legacy-JAX check_rep choice per engine config.  TP/EP/PP need
         # the check_rep=True rewrite (it auto-inserts the gradient psums
         # for replicated params).  Pure SP (optionally x FSDP) does every
@@ -476,21 +515,33 @@ class LocalSGDEngine:
         # outside so StepLR can drive it per local epoch.
         self.tx = optax.scale_by_adam(b1=0.9, b2=0.999, eps=1e-8)
         self._round_cache: dict[tuple, Callable] = {}
-        self._spec = P(DATA_AXIS)
-        # --- round-sync engine selection (ISSUE 2) ---------------------
+        self._spec = (P((SLICE_AXIS, DATA_AXIS)) if self.slice_axis
+                      else P(DATA_AXIS))
+        # --- round-sync engine selection (ISSUE 2 / ISSUE 13) ----------
         self.sync_mode = self._resolve_sync_mode()
-        self.sync_wire_dtype = {"bfloat16": jnp.bfloat16,
-                                "int8": jnp.int8}.get(
-                                    cfg.sync_dtype, jnp.float32)
+        _wdt = {"bfloat16": jnp.bfloat16, "int8": jnp.int8}
+        self.sync_wire_dtype = _wdt.get(cfg.sync_dtype, jnp.float32)
+        # outer (DCN) gossip wire of the hierarchical sync — inherits the
+        # inner choice when --sync_dtype_outer is unset (ISSUE 13)
+        outer_name = (getattr(cfg, "sync_dtype_outer", "")
+                      or cfg.sync_dtype)
+        self.sync_wire_dtype_outer = _wdt.get(outer_name, jnp.float32)
         # error feedback needs per-worker residual state, which only the
         # weights (FedAvg) aggregation carries forward; in gradients mode
         # the aggregate is discarded after its norm, so compression error
         # has nothing to accumulate into.  The residual carries
         # per-topology: own+mean rounding for the sharded reduce-scatter,
-        # own-transmission rounding for the gossip engines.
-        self.sync_ef = (cfg.sync_compression == "ef"
-                        and cfg.aggregation_by == "weights"
-                        and self.sync_mode in ("sharded", "gossip"))
+        # own-transmission rounding for the gossip engines.  Hierarchical
+        # runs arm EF PER LEVEL: the flat inner residual exactly when the
+        # ICI wire is compressed, and the new OUTER residual
+        # (TrainState.sync_residual_outer) exactly when the DCN wire is.
+        _ef = (cfg.sync_compression == "ef"
+               and cfg.aggregation_by == "weights")
+        self.sync_ef = (_ef
+                        and self.sync_mode in ("sharded", "gossip", "hier")
+                        and cfg.sync_dtype in ("bfloat16", "int8"))
+        self.sync_ef_outer = (_ef and self.sync_mode == "hier"
+                              and outer_name in ("bfloat16", "int8"))
         self.sync_bucket_bytes = max(1, int(cfg.sync_bucket_mb * (1 << 20)))
         # --- shard-resident optimizer placement (ISSUE 9) ---------------
         # Where the round-boundary apply runs and where its state lives:
@@ -509,11 +560,23 @@ class LocalSGDEngine:
         # plan).  Inner mesh axes (TP/PP/EP/FSDP/SP) shard the gradient
         # leaves themselves, which would make the bucket plan
         # per-device; the tracker stays off there (documented).
+        # (hierarchical runs keep the tracker OFF — sync_mode "hier"
+        # fails the check below by design: the aggregated mean is
+        # per-SLICE under gossip mixing, not a single worker-invariant
+        # global vector, so the flat tracker layout does not apply;
+        # documented v1 demotion, docs/ARCHITECTURE.md)
         self.round_opt_on = (
             cfg.aggregation_by == "gradients"
             and self.sync_mode == "sharded"
             and self.opt_placement in ("replicated", "sharded")
             and not self._inner_axes)
+        if self.sync_mode == "hier" and self.n_inner < 2:
+            raise ValueError(
+                f"--num_slices {self.n_slices} needs >= 2 workers per "
+                f"slice (got a data axis of {self.n_inner}): the outer "
+                "gossip hop rides the 1/W inner scatter shard — with "
+                "W = 1 there is no inner level, run the flat gossip "
+                "engine (--num_slices 1)")
         if (cfg.opt_placement == "sharded"
                 and self.opt_placement == "local"):
             log.info(
@@ -536,7 +599,7 @@ class LocalSGDEngine:
         self.param_residency = cfg.resolve_param_residency(
             jax.default_backend())
         if (self.param_residency == "resident"
-                and (self._inner_axes or self.n_workers < 2)):
+                and (self._inner_axes or self.n_inner < 2)):
             self.param_residency = "replicated"
             if cfg.param_residency == "resident":
                 log.info(
@@ -562,8 +625,13 @@ class LocalSGDEngine:
         # explicit "buddy" with nothing shard-resident demotes with a
         # log (config rejected the eagerly-decidable cases).
         redundancy = getattr(cfg, "shard_redundancy", "auto")
+        # hierarchical runs resolve buddy OFF (ISSUE 13 v1: the buddy
+        # map is the flat worker-axis ring and crash recovery — its only
+        # consumer — is rejected under slices; explicit buddy was
+        # rejected eagerly in config)
         self.buddy_on = (
             redundancy != "off" and self.n_workers >= 2
+            and self.n_slices == 1
             and (self.resident_on
                  or (self.round_opt_on
                      and self.opt_placement == "sharded")))
@@ -600,6 +668,7 @@ class LocalSGDEngine:
         self.last_sync_stats: dict | None = None
         self._sync_probe = None      # (ready_marker | None, sync_out_ref)
         self._sync_bytes: int | None = None
+        self._sync_bytes_split: tuple = (0, 0)   # (ici, dcn) per level
 
     # ------------------------------------------------------------------
     # Round-sync engine (ISSUE 2): dense vs sharded reduce-scatter
@@ -619,11 +688,11 @@ class LocalSGDEngine:
         return self.cfg.resolve_sync_mode(jax.default_backend())
 
     def _sync_body(self, params, grads, residual, round_opt=None,
-                   poison=None):
+                   poison=None, outer_residual=None):
         """The once-per-round sync point, per worker (inside shard_map).
 
         Returns ``(params', resident', residual', round_opt', buddy',
-        ok, agg_grad_norm)``.  Weights mode replaces params with the
+        ok, agg_grad_norm, outer_residual')``.  Weights mode replaces params with the
         aggregate (FedAvg) — under the resident layout (ISSUE 11) the
         program ENDS at the scatter instead: ``params'`` is None and
         ``resident'`` carries the post-apply 1/N bucket shards, the
@@ -646,6 +715,35 @@ class LocalSGDEngine:
         ok = None
         screen = poison is not None
         fast = self.sync_mode in ("sharded", "gossip")
+        if self.sync_mode == "hier":
+            # hierarchical two-level sync (ISSUE 13): inner sharded
+            # allreduce over the data axis x outer gossip over the
+            # slice axis, one program; the NaN screen / buddy hop are
+            # not composed (chaos is rejected under --num_slices > 1)
+            if screen:
+                raise ValueError(
+                    "the hierarchical sync does not take a poison flag "
+                    "(--chaos is rejected under --num_slices > 1)")
+            if cfg.aggregation_by == "weights":
+                first, residual, outer_residual = comms.hierarchical_sync(
+                    params,
+                    residual=residual if self.sync_ef else None,
+                    outer_residual=(outer_residual if self.sync_ef_outer
+                                    else None),
+                    **self._hier_kwargs())
+                if self.resident_on:
+                    resident, params = first, None
+                else:
+                    params = first
+            else:
+                # gradients mode: the reference's aggregate-and-discard
+                # semantics through the hierarchical program — the
+                # collectives run, only the norm is reported
+                agg, _r, _o = comms.hierarchical_sync(
+                    grads, **self._hier_kwargs(residency="replicated"))
+                agg_grad_norm = self._grad_global_norm(agg)
+            return params, resident, residual, round_opt, buddy, ok, \
+                agg_grad_norm, outer_residual
         if cfg.aggregation_by == "weights":
             if self.resident_on:
                 rets = comms.sharded_opt_sync(
@@ -686,7 +784,21 @@ class LocalSGDEngine:
                 agg, ok = self._dense_sync(grads, poison)
             agg_grad_norm = self._grad_global_norm(agg)
         return params, resident, residual, round_opt, buddy, ok, \
-            agg_grad_norm
+            agg_grad_norm, outer_residual
+
+    def _hier_kwargs(self, residency: str | None = None) -> dict:
+        """Shared kwargs of the hierarchical sync calls (ISSUE 13): the
+        outer topology is ``--topology`` (ring / double_ring over the
+        slice axis), the per-level wire dtypes, and the engine's
+        resolved residency (overridable — gradients mode always runs
+        replicated, its aggregate is discarded)."""
+        cfg = self.cfg
+        return dict(topology=cfg.topology, how=cfg.aggregation_type,
+                    local_weight=cfg.local_weight,
+                    wire_dtype=self.sync_wire_dtype,
+                    outer_wire_dtype=self.sync_wire_dtype_outer,
+                    bucket_bytes=self.sync_bucket_bytes,
+                    residency=residency or self.param_residency)
 
     def _dense_sync(self, tree, poison):
         """Legacy dense per-leaf aggregate, screen-aware: returns
@@ -753,13 +865,30 @@ class LocalSGDEngine:
                 shapes = jax.tree_util.tree_map(
                     lambda a: jax.ShapeDtypeStruct(a.shape[1:], a.dtype),
                     params_stacked)
-            wire = (self.sync_wire_dtype
-                    if self.sync_mode in ("sharded", "gossip")
-                    else jnp.float32)
-            self._sync_bytes = comms.sync_wire_bytes(
-                shapes, self.n_workers, mode=self.sync_mode,
-                wire_dtype=wire, bucket_bytes=self.sync_bucket_bytes,
-                topology=self.cfg.topology)
+            if self.sync_mode == "hier":
+                # per-LEVEL accounting (ISSUE 13): the inner sharded
+                # engine's bytes ride ICI, the outer gossip hop's ride
+                # DCN — the hop moves each bucket's 1/W scatter shard
+                # in the outer wire dtype (tests/test_sync.py asserts
+                # both exactly)
+                split = comms.hier_wire_bytes(
+                    shapes, self.n_inner, topology=self.cfg.topology,
+                    wire_dtype=self.sync_wire_dtype,
+                    outer_wire_dtype=self.sync_wire_dtype_outer,
+                    bucket_bytes=self.sync_bucket_bytes)
+                self._sync_bytes_split = (split["ici"], split["dcn"])
+                self._sync_bytes = split["ici"] + split["dcn"]
+            else:
+                wire = (self.sync_wire_dtype
+                        if self.sync_mode in ("sharded", "gossip")
+                        else jnp.float32)
+                self._sync_bytes = comms.sync_wire_bytes(
+                    shapes, self.n_workers, mode=self.sync_mode,
+                    wire_dtype=wire, bucket_bytes=self.sync_bucket_bytes,
+                    topology=self.cfg.topology)
+                # flat engines: every wire byte is one level (intra-slice
+                # — "ICI-shaped" in the two-level schema), zero DCN
+                self._sync_bytes_split = (self._sync_bytes, 0)
             if self.buddy_on:
                 # ISSUE 12: the buddy hop's wire bytes ride the same
                 # accounting — one extra ppermute per bucket carrying
@@ -772,9 +901,25 @@ class LocalSGDEngine:
                     tracker=(self.round_opt_on
                              and self.opt_placement == "sharded"),
                     ef=self.resident_on and self.sync_ef)
+                # the buddy hop is intra-slice wire (buddy_on implies a
+                # flat mesh): its bytes ride the ICI level of the split
+                self._sync_bytes_split = (self._sync_bytes, 0)
+        ici, dcn = self._sync_bytes_split
         self.last_sync_stats = {"sync_bytes": self._sync_bytes,
                                 "sync_mode": self.sync_mode,
-                                "sync_ms": 0.0}
+                                "sync_ms": 0.0,
+                                # per-level split (ISSUE 13): identical
+                                # schema on every engine — flat rounds
+                                # report all bytes as the intra-slice
+                                # (ICI) level and zero DCN, hierarchical
+                                # rounds the true split; the ms fields
+                                # are the byte-proportional attribution
+                                # of the measured sync wall
+                                # (probe.attribute_sync_wall)
+                                "sync_bytes_ici": ici,
+                                "sync_bytes_dcn": dcn,
+                                "sync_ms_ici": 0.0,
+                                "sync_ms_dcn": 0.0}
         self._sync_probe = None
 
     def state_resident_bytes(self, state: TrainState) -> dict:
@@ -807,17 +952,24 @@ class LocalSGDEngine:
         if state.params is None and state.params_resident is not None:
             # the gather's transient buffers are the PADDED bucket
             # vectors — each resident leaf [N, padded/N] regathers to
-            # [padded], i.e. the leaf's own nbytes
+            # [padded], i.e. the leaf's own nbytes.  Hierarchical
+            # layouts (ISSUE 13) stack S slices of W shard rows
+            # ([S*W, padded/W]), and the entry gather runs over the
+            # inner axis only — each worker's transient buffer is still
+            # ONE padded vector (its slice's), i.e. nbytes / S
             gathered_peak = sum(
                 int(np.prod(np.shape(leaf), dtype=np.int64))
                 * np.dtype(leaf.dtype).itemsize
                 for leaf in jax.tree_util.tree_leaves(
-                    state.params_resident))
+                    state.params_resident)) // max(1, self.n_slices)
         return {"params": (per_worker(state.params)
                            + per_worker(state.params_resident)),
                 "params_gathered_peak": gathered_peak,
                 "opt_state": per_worker(state.opt_state),
                 "ef_residual": per_worker(state.sync_residual),
+                # ISSUE 13: the outer (DCN) EF residual — 1/W of the
+                # packed vector per worker, by construction
+                "ef_residual_outer": per_worker(state.sync_residual_outer),
                 "round_opt": per_worker(state.round_opt),
                 # ISSUE 12: the buddy copy's per-worker cost — one extra
                 # shard-row set, i.e. ~1/N of each protected component
@@ -873,14 +1025,20 @@ class LocalSGDEngine:
         if state.params is not None:
             return jax.tree_util.tree_map(_first_worker_row, state.params)
         return resident_consensus(state, self.params_template,
-                                  self.sync_bucket_bytes)
+                                  self.sync_bucket_bytes,
+                                  self.n_inner if self.slice_axis
+                                  else None)
 
     def rank0_variables(self, state: TrainState) -> dict:
         """``train.rank0_variables`` with the engine's residency context
         threaded through — works on replicated AND scatter-resident
-        states (the driver's probe / final-eval surface)."""
+        states (the driver's probe / final-eval surface).  Hierarchical
+        states take slice 0's consensus (rows 0..W-1), the resident twin
+        of the replicated worker-0-row convention."""
         return rank0_variables(state, params_template=self.params_template,
-                               bucket_bytes=self.sync_bucket_bytes)
+                               bucket_bytes=self.sync_bucket_bytes,
+                               n_inner=(self.n_inner if self.slice_axis
+                                        else None))
 
     # ------------------------------------------------------------------
     # Multi-host data movement
@@ -965,13 +1123,21 @@ class LocalSGDEngine:
         # layout starts scatter-resident from round 0 — every round
         # program then has the one shape (resident in, resident out) and
         # the sanitizer's zero-retrace budget holds from the warmup on
+        # hierarchical meshes (ISSUE 13): the bucket tiling is per INNER
+        # shard (padded // W) while the rows stack all S x W workers —
+        # the broadcast-init consensus is every slice's consensus, so
+        # the one shard set tiles across the slice groups
         resident = (comms.resident_from_tree(
-            jax.device_get(params), n,
-            bucket_bytes=self.sync_bucket_bytes)
+            jax.device_get(params), self.n_inner,
+            bucket_bytes=self.sync_bucket_bytes, n_rows=n)
             if self.resident_on else None)
         sync_residual = (jax.tree_util.tree_map(
             lambda x: jnp.zeros((n, *x.shape), jnp.float32), params)
             if self.sync_ef else None)
+        sync_residual_outer = (comms.hier_outer_residual_init(
+            params, self.n_inner, n,
+            bucket_bytes=self.sync_bucket_bytes)
+            if self.sync_ef_outer else None)
         round_opt = (comms.round_opt_init(
             params, n, placement=self.opt_placement,
             bucket_bytes=self.sync_bucket_bytes)
@@ -986,6 +1152,7 @@ class LocalSGDEngine:
                 jax.random.fold_in(jax.random.key(self.cfg.seed), i)))(
                     jnp.arange(n)),
             sync_residual=sync_residual,
+            sync_residual_outer=sync_residual_outer,
             round_opt=round_opt,
             # ISSUE 12: the buddy copy exists from round 0 on (derivable
             # on host — ring-rolled rows of the layouts above), so every
@@ -1556,8 +1723,10 @@ class LocalSGDEngine:
                     eval_step, (eval_params, batch_stats), (xv, yv, mv))
                 val_loss = vls.sum() / jnp.maximum(vts.sum(), 1.0)
                 val_acc = 100.0 * vcs.sum() / jnp.maximum(vts.sum(), 1.0)
-                # cross-worker mean accuracy per local epoch (trainer.py:50-53)
-                avg_acc = lax.pmean(train_acc, DATA_AXIS)
+                # cross-worker mean accuracy per local epoch
+                # (trainer.py:50-53) — over the WHOLE worker grid:
+                # (slice, data) on a hierarchical mesh (ISSUE 13)
+                avg_acc = lax.pmean(train_acc, self._stack_axes)
                 lr_epoch = lr_epoch + 1
                 per_epoch = dict(
                     batch_losses=losses, batch_mask=real_step,
@@ -1581,28 +1750,30 @@ class LocalSGDEngine:
             # it (measured collective wall, two-rounds-in-flight chain).
             agg_grad_norm = jnp.zeros(())
             residual = state.sync_residual
+            outer_residual = state.sync_residual_outer
             round_opt = state.round_opt
             resident = None
             new_buddy = None
             sync_ok = None
             if not self.split_sync:
                 params, resident, residual, round_opt, new_buddy, \
-                    sync_ok, agg_grad_norm = self._sync_body(
+                    sync_ok, agg_grad_norm, outer_residual = \
+                    self._sync_body(
                         params, last_grads, residual, round_opt,
-                        poison=poison)
+                        poison=poison, outer_residual=outer_residual)
 
             # cross-worker global-epoch metric means (trainer.py:152-162)
             metrics = dict(
                 per_epoch,
                 agg_grad_norm=agg_grad_norm,
                 global_train_loss=lax.pmean(
-                    per_epoch["train_loss"].mean(), DATA_AXIS),
+                    per_epoch["train_loss"].mean(), self._stack_axes),
                 global_train_acc=lax.pmean(
-                    per_epoch["train_acc"].mean(), DATA_AXIS),
+                    per_epoch["train_acc"].mean(), self._stack_axes),
                 global_val_loss=lax.pmean(
-                    per_epoch["val_loss"].mean(), DATA_AXIS),
+                    per_epoch["val_loss"].mean(), self._stack_axes),
                 global_val_acc=lax.pmean(
-                    per_epoch["val_acc"].mean(), DATA_AXIS),
+                    per_epoch["val_acc"].mean(), self._stack_axes),
             )
             if sync_ok is not None:
                 metrics = dict(metrics, sync_ok=sync_ok)
@@ -1610,7 +1781,8 @@ class LocalSGDEngine:
                                    batch_stats=batch_stats,
                                    opt_state=opt_state, lr_epoch=lr_epoch,
                                    rng=rng, sync_residual=residual,
-                                   round_opt=round_opt, buddy=new_buddy)
+                                   round_opt=round_opt, buddy=new_buddy,
+                                   sync_residual_outer=outer_residual)
             if emit_grads:
                 # split_sync x gradients mode: the standalone sync program
                 # aggregates the stale last-batch grads, so the round
@@ -1735,10 +1907,17 @@ class LocalSGDEngine:
                 self._round_cache["sync"] = self._build_sync()
             sync = self._round_cache["sync"]
             if self.cfg.aggregation_by == "weights":
-                d = (sync(new_state.params, new_state.sync_residual,
-                          poison=poison) if self.sync_ef
-                     else sync(new_state.params, poison=poison))
+                args = [new_state.params]
+                if self.sync_ef:
+                    args.append(new_state.sync_residual)
+                if self.sync_ef_outer:
+                    # ISSUE 13: the outer (DCN) EF rows ride the
+                    # standalone program as their own donated input
+                    args.append(new_state.sync_residual_outer)
+                d = sync(*args, poison=poison)
                 residual = d.get("residual", new_state.sync_residual)
+                outer_res = d.get("outer_residual",
+                                  new_state.sync_residual_outer)
                 if self.resident_on:
                     # the sync ended at the scatter: the resident bucket
                     # shards replace the (donated) full params as the
@@ -1746,10 +1925,12 @@ class LocalSGDEngine:
                     new_state = new_state.replace(
                         params=None, params_resident=d["out"],
                         sync_residual=residual,
+                        sync_residual_outer=outer_res,
                         buddy=d.get("buddy"))
                 else:
-                    new_state = new_state.replace(params=d["out"],
-                                                  sync_residual=residual)
+                    new_state = new_state.replace(
+                        params=d["out"], sync_residual=residual,
+                        sync_residual_outer=outer_res)
                 fence = d["fence"]
             else:
                 if self.round_opt_on:
@@ -1781,8 +1962,13 @@ class LocalSGDEngine:
             t0 = time.perf_counter()
             jax.block_until_ready(out_ref)
             if self.last_sync_stats is not None:
-                self.last_sync_stats["sync_ms"] = round(
-                    (time.perf_counter() - t0) * 1e3, 3)
+                sync_ms = round((time.perf_counter() - t0) * 1e3, 3)
+                self.last_sync_stats["sync_ms"] = sync_ms
+                from . import probe as probe_lib
+                ici_ms, dcn_ms = probe_lib.attribute_sync_wall(
+                    sync_ms, *self._sync_bytes_split)
+                self.last_sync_stats["sync_ms_ici"] = ici_ms
+                self.last_sync_stats["sync_ms_dcn"] = dcn_ms
         return jax.block_until_ready(new_state)
 
     def checkpoint_fence(self, state: TrainState) -> TrainState:
@@ -1937,6 +2123,9 @@ class LocalSGDEngine:
         pspec = self._sspec.params if self._sspec is not None else self._spec
         weights = cfg.aggregation_by == "weights"
         takes_residual = weights and self.sync_ef
+        # ISSUE 13: the outer (DCN) EF residual is its own donated input
+        # of the hierarchical standalone sync
+        takes_outer = weights and self.sync_ef_outer
         takes_tracker = (not weights) and self.round_opt_on
         screen = self.nan_screen
 
@@ -1944,9 +2133,12 @@ class LocalSGDEngine:
             idx = 0
             primary = args[idx]
             idx += 1
-            residual = tracker = poi = None
+            residual = outer_res = tracker = poi = None
             if takes_residual:
                 residual = args[idx]
+                idx += 1
+            if takes_outer:
+                outer_res = args[idx]
                 idx += 1
             if takes_tracker:
                 tracker = args[idx]
@@ -1954,14 +2146,17 @@ class LocalSGDEngine:
             if screen:
                 poi = args[idx]
             if weights:
-                p, res, r, _t, bud, ok, _ = self._sync_body(
-                    primary, None, residual, poison=poi)
+                p, res, r, _t, bud, ok, _, oret = self._sync_body(
+                    primary, None, residual, poison=poi,
+                    outer_residual=outer_res)
                 out = res if self.resident_on else p
                 d = {"out": out, "fence": _fence(out)}
                 if takes_residual:
                     d["residual"] = r
+                if takes_outer:
+                    d["outer_residual"] = oret
             else:
-                _p, _res, _r, trk, bud, ok, norm = self._sync_body(
+                _p, _res, _r, trk, bud, ok, norm, _o = self._sync_body(
                     None, primary, None, tracker, poison=poi)
                 d = {"out": norm}
                 if takes_tracker:
@@ -1976,10 +2171,13 @@ class LocalSGDEngine:
         donate = [0]
         if takes_residual:
             in_specs.append(pspec)
-            donate.append(1)
+            donate.append(len(in_specs) - 1)
+        if takes_outer:
+            in_specs.append(self._spec)
+            donate.append(len(in_specs) - 1)
         if takes_tracker:
             in_specs.append(self._spec)
-            donate.append(1)
+            donate.append(len(in_specs) - 1)
         if screen:
             in_specs.append(self._spec)   # [N] poison flags, not donated
         out_specs: dict = {"out": (self._spec if (self.resident_on
@@ -1989,6 +2187,8 @@ class LocalSGDEngine:
             out_specs["fence"] = self._spec
         if takes_residual:
             out_specs["residual"] = pspec
+        if takes_outer:
+            out_specs["outer_residual"] = self._spec
         if takes_tracker:
             out_specs["tracker"] = self._spec
         if self.buddy_on:
@@ -2136,15 +2336,21 @@ class LocalSGDEngine:
         sync = self._round_cache["sync"]
         self._arm_sync_stats(params)
         residual = state.sync_residual
+        outer_res = state.sync_residual_outer
         round_opt = state.round_opt
         resident = None
         new_buddy = None
         sync_ok = None
         if cfg.aggregation_by == "weights":
-            d = (sync(params, residual, poison=poison) if self.sync_ef
-                 else sync(params, poison=poison))
+            args = [params]
+            if self.sync_ef:
+                args.append(residual)
+            if self.sync_ef_outer:
+                args.append(outer_res)
+            d = sync(*args, poison=poison)
             synced, fence = d["out"], d["fence"]
             residual = d.get("residual", residual)
+            outer_res = d.get("outer_residual", outer_res)
             new_buddy = d.get("buddy")
             sync_ok = d.get("ok")
             if self.resident_on:
@@ -2186,7 +2392,7 @@ class LocalSGDEngine:
             batch_stats=batch_stats, opt_state=opt_state,
             lr_epoch=self._round_cache["bump_epoch"](state.lr_epoch),
             rng=rng, sync_residual=residual, round_opt=round_opt,
-            buddy=new_buddy)
+            buddy=new_buddy, sync_residual_outer=outer_res)
         return new_state, ("streamed", per_epoch, agg_grad_norm, sync_ok)
 
     def _assemble_streamed(self, per_epoch, agg_grad_norm) -> dict:

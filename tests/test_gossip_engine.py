@@ -222,12 +222,19 @@ class TestEngineGossip:
             assert np.array_equal(np.asarray(a), np.asarray(b))
         # telemetry schema parity (ISSUE 4 satellite): identical keys on
         # every engine, sync_ms zero-filled where no standalone sync
-        # program ran (CPU fuses the sync into the round program)
-        keys = {"sync_bytes", "sync_mode", "sync_ms"}
+        # program ran (CPU fuses the sync into the round program).
+        # ISSUE 13 widened the schema with the per-LEVEL split — flat
+        # engines report every byte as the intra-slice (ICI) level
+        keys = {"sync_bytes", "sync_mode", "sync_ms",
+                "sync_bytes_ici", "sync_bytes_dcn",
+                "sync_ms_ici", "sync_ms_dcn"}
         assert set(eng_d.last_sync_stats) == keys
         assert set(eng_g.last_sync_stats) == keys
         assert eng_g.last_sync_stats["sync_bytes"] > 0
         assert eng_g.last_sync_stats["sync_ms"] == 0.0
+        assert eng_g.last_sync_stats["sync_bytes_ici"] == \
+            eng_g.last_sync_stats["sync_bytes"]
+        assert eng_g.last_sync_stats["sync_bytes_dcn"] == 0
 
 
 class TestGossipConfigResolution:

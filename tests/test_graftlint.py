@@ -412,6 +412,39 @@ def sync_round(sync, params, residual):
 """
         assert "R4" not in rules_for(src)
 
+    def test_hier_outer_residual_use_after_donate_flagged(self):
+        # ISSUE 13 fixture: the hierarchical standalone sync donates the
+        # params AND both EF residual levels (train._build_sync
+        # donate=(0, 1, 2)); reading the donated OUTER residual rows
+        # after the call — instead of the fresh generation the program's
+        # output dict carries — touches freed 1/W-span buffers, the
+        # exact hazard class R4 exists for
+        src = """
+import jax
+def hier_round(sync, params, residual, outer_residual):
+    prog = jax.jit(sync, donate_argnums=(0, 1, 2))
+    out = prog(params, residual, outer_residual)
+    stale = outer_residual  # donated DCN EF rows read after the sync
+    return out, stale
+"""
+        assert "R4" in rules_for(src)
+
+    def test_hier_outer_residual_rebound_clean(self):
+        # the engine's real shape: every donated level is rebound to the
+        # program's output dict before any further read (round_start /
+        # round_streamed_start)
+        src = """
+import jax
+def hier_round(sync, params, residual, outer_residual):
+    prog = jax.jit(sync, donate_argnums=(0, 1, 2))
+    out = prog(params, residual, outer_residual)
+    params = out["out"]
+    residual = out["residual"]
+    outer_residual = out["outer_residual"]
+    return params, residual, outer_residual
+"""
+        assert "R4" not in rules_for(src)
+
     def test_rebound_name_no_longer_shard_map_clean(self):
         src = """
 import jax
@@ -668,11 +701,29 @@ def f(x):
         assert "R1" in injected.stdout
 
     def test_axis_vocab_discovered_from_mesh_py(self):
+        # ISSUE 13: the hierarchical mesh's ``slice`` outer axis is an
+        # X_AXIS constant in mesh.py, so R3's vocabulary discovery must
+        # pick it up — collectives over "slice" lint clean, typos don't
         from tools.graftlint.core import discover_axis_vocab
         vocab, constants = discover_axis_vocab([PKG])
         assert {"data", "model", "pipe", "seq", "expert",
-                "fsdp"} <= set(vocab)
+                "fsdp", "slice"} <= set(vocab)
         assert constants.get("DATA_AXIS") == "data"
+        assert constants.get("SLICE_AXIS") == "slice"
+
+    def test_slice_axis_collectives_lint_clean(self):
+        # the hierarchical program's shape: psum_scatter over the inner
+        # axis, ppermute over the discovered "slice" outer axis
+        src = """
+from jax import lax
+def hier(m, ns):
+    r1 = lax.ppermute(m, "slice", [(i, (i + 1) % ns)
+                                   for i in range(ns)])
+    return (m + r1) / 2.0
+"""
+        assert "R3" not in rules_for(src)
+        bad = src.replace('"slice"', '"slices"')
+        assert "R3" in rules_for(bad)
 
     def test_finding_str_and_key(self):
         f = Finding("a.py", 3, 1, "R1", "msg", "  x.item()  ")

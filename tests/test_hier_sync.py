@@ -1,0 +1,650 @@
+"""Hierarchical two-level sync engine (ISSUE 13 tentpole).
+
+The gate, per the framework's standing bar: the bucketed hierarchical
+program (inner sharded psum_scatter/all_gather over the ICI-shaped
+``data`` axis x outer per-bucket ppermute gossip over the DCN-shaped
+``slice`` axis, one program) is BITWISE-identical in fp32 to the flat
+gossip-of-means reference — ``comms.aggregate_hier``, the same
+expressions evaluated per leaf from the flat primitives (lax.pmean +
+the dense gossip blends) — across 2x2 / 2x4 / 4x2 layouts x
+ring/double-ring x equal/weighted; at ``--num_slices 1`` the config
+resolves the UNCHANGED flat engine (whose dense-twin bitwise gates are
+tests/test_sync.py's).  Outer (DCN) wire bytes are exactly
+``hops x shard_row x outer_wire_itemsize`` per bucket — 1/N_inner of
+the flat gossip payload — with bf16/int8 outer wire at exactly 1/2 and
+1/4 of that.  Per-level EF, scatter-resident composition (PR 11),
+cross-slice checkpoint re-layouts, and the eager v1 rejections ride
+along.  Driver-level S x W sweeps are slow-marked per the ROADMAP
+tier-1 wall-headroom rule; the tier-1 subset stays well under ~30 s.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from learning_deep_neural_network_in_distributed_computing_environment_tpu import (
+    checkpoint as ckpt_lib,
+    comms,
+    mesh as mesh_lib,
+)
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import get_model
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.train import LocalSGDEngine
+
+# uneven leaf sizes (nothing divisible by the worker counts) so every
+# bucket needs padding and the pack/pad/unpack plumbing is exercised
+SHAPES = {"a": (13, 7), "b": (257,), "c": (31, 5), "d": (3,)}
+TINY_BUCKET = 1024
+LAYOUTS = [(2, 2), (2, 4), (4, 2)]   # (slices, workers-per-slice)
+
+
+def stacked_tree(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return {k: jnp.asarray(rng.normal(size=(n, *s)), jnp.float32)
+            for k, s in SHAPES.items()}
+
+
+def slice_mesh(s, w):
+    return mesh_lib.build_mesh({"slice": s, "data": w},
+                               devices=jax.devices()[:s * w])
+
+
+def per_worker_struct(tree):
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape[1:], x.dtype), tree)
+
+
+def trees_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert la and len(la) == len(lb)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+def hier_cfg(**kw):
+    base = dict(model="mlp", dataset="mnist", epochs_local=1,
+                epochs_global=2, batch_size=8, compute_dtype="float32",
+                augment=False, aggregation_by="weights", topology="ring",
+                num_slices=2, sync_bucket_mb=0.001)
+    base.update(kw)
+    return Config(**base)
+
+
+# --------------------------------------------------------------------------
+# Config resolution + eager v1 validation (ISSUE 13 satellite)
+# --------------------------------------------------------------------------
+class TestConfigResolution:
+    def test_hier_mode_and_levels(self):
+        for topo in ("ring", "double_ring"):
+            cfg = hier_cfg(topology=topo)
+            assert cfg.resolve_sync_mode("cpu") == "hier"
+            assert cfg.resolve_sync_mode("tpu") == "hier"
+            assert cfg.resolve_sync_levels("cpu") == {
+                "inner": "sharded", "outer": "gossip"}
+            # the apply necessarily runs on the inner shard
+            assert cfg.resolve_opt_placement("cpu") == "sharded"
+
+    def test_one_slice_resolves_the_flat_engine_unchanged(self):
+        # the 1-slice limit of the bitwise gate: no hier program exists —
+        # the resolution is EXACTLY the pre-ISSUE-13 flat one (whose
+        # dense-twin bitwise gates live in tests/test_sync.py)
+        cfg = hier_cfg(num_slices=1)
+        assert cfg.resolve_sync_mode("cpu") == "dense"       # ring, CPU
+        assert cfg.resolve_sync_levels("cpu") == {
+            "inner": "dense", "outer": None}
+        flat = hier_cfg(num_slices=1, topology="allreduce",
+                        sync_mode="sharded")
+        assert flat.resolve_sync_mode("cpu") == "sharded"
+
+    def test_mesh_axes_lead_with_slice(self):
+        axes = hier_cfg().mesh_axes()
+        assert list(axes)[0] == "slice" and axes["slice"] == 2
+
+    def test_wire_dtypes_outer_inherits(self):
+        assert hier_cfg(sync_dtype="bfloat16",
+                        ).resolve_sync_wire_dtypes() == ("bfloat16",
+                                                         "bfloat16")
+        assert hier_cfg(sync_dtype_outer="int8",
+                        ).resolve_sync_wire_dtypes() == ("float32", "int8")
+
+    def test_residency_auto_resolves_resident(self):
+        assert hier_cfg().resolve_param_residency("cpu") == "resident"
+        assert hier_cfg(aggregation_type="weighted",
+                        ).resolve_param_residency("cpu") == "replicated"
+        assert hier_cfg(aggregation_by="gradients",
+                        ).resolve_param_residency("cpu") == "replicated"
+
+
+class TestEagerValidation:
+    def test_allreduce_outer_rejected(self):
+        with pytest.raises(ValueError, match="flat sharded allreduce"):
+            hier_cfg(topology="allreduce")
+
+    def test_dense_inner_rejected(self):
+        with pytest.raises(ValueError, match="dense inner level has no"):
+            hier_cfg(sync_mode="dense")
+
+    def test_chaos_rejected(self):
+        with pytest.raises(ValueError, match="chaos cannot combine"):
+            hier_cfg(chaos="kill@1:w0")
+        with pytest.raises(ValueError, match="chaos cannot combine"):
+            hier_cfg(chaos="random")
+
+    def test_explicit_buddy_rejected(self):
+        with pytest.raises(ValueError, match="buddy cannot combine"):
+            hier_cfg(shard_redundancy="buddy")
+        # auto resolves off: nothing raises, the engine disarms it
+        eng = LocalSGDEngine(get_model("mlp", num_classes=10, hidden=8),
+                             slice_mesh(2, 2), hier_cfg())
+        assert not eng.buddy_on
+
+    def test_replicated_opt_placement_rejected(self):
+        with pytest.raises(ValueError, match="opt_placement replicated"):
+            hier_cfg(opt_placement="replicated")
+
+    def test_outer_wire_needs_slices(self):
+        with pytest.raises(ValueError, match="requires --num_slices"):
+            hier_cfg(num_slices=1, sync_dtype_outer="int8")
+
+    def test_inner_model_axes_rejected(self):
+        with pytest.raises(ValueError, match="inner mesh axes"):
+            hier_cfg(mesh_shape="data=2,model=2").mesh_axes()
+
+    def test_slice_in_mesh_shape_rejected(self):
+        with pytest.raises(ValueError, match="driven by --num_slices"):
+            hier_cfg(mesh_shape="slice=2,data=2").mesh_axes()
+
+    def test_one_worker_per_slice_rejected_by_engine(self):
+        with pytest.raises(ValueError, match="workers per"):
+            LocalSGDEngine(get_model("mlp", num_classes=10, hidden=8),
+                           slice_mesh(4, 1), hier_cfg(num_slices=4))
+
+    def test_elastic_snapshot_rejected_by_driver(self):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+        with pytest.raises(ValueError, match="elastic_snapshot cannot"):
+            train_global(hier_cfg(), elastic_snapshot=object(),
+                         progress=False)
+
+    def test_hierarchical_sync_rejects_allreduce_topology(self):
+        with pytest.raises(ValueError, match="outer topology"):
+            comms.hierarchical_sync({"x": jnp.zeros(4)},
+                                    topology="allreduce")
+
+
+# --------------------------------------------------------------------------
+# The tentpole bitwise gate (comms level, full S x W matrix)
+# --------------------------------------------------------------------------
+class TestHierBitwise:
+    @pytest.mark.parametrize("layout", LAYOUTS)
+    @pytest.mark.parametrize("topo", ["ring", "double_ring"])
+    @pytest.mark.parametrize("how", ["equal", "weighted"])
+    def test_fp32_bucketed_equals_gossip_of_means_reference(
+            self, layout, topo, how):
+        s, w = layout
+        mesh = slice_mesh(s, w)
+        tree = stacked_tree(s * w)
+        ref = comms.make_hier_host_aggregator(
+            mesh, topology=topo, how=how, local_weight=0.3)(tree)
+        out = comms.make_hier_host_sync(
+            mesh, topology=topo, how=how, local_weight=0.3,
+            bucket_bytes=TINY_BUCKET)(tree)[0]
+        for key in SHAPES:
+            assert np.array_equal(np.asarray(ref[key]),
+                                  np.asarray(out[key])), (layout, topo,
+                                                          how, key)
+
+    def test_semantics_match_numpy_gossip_of_means(self):
+        # the reference itself is pinned against plain numpy: slice
+        # means then the ring blend, per element (fp32 tolerance — the
+        # np summation order is not the XLA reduction's)
+        s, w = 2, 4
+        mesh = slice_mesh(s, w)
+        tree = stacked_tree(s * w)
+        out = comms.make_hier_host_sync(
+            mesh, topology="ring", bucket_bytes=TINY_BUCKET)(tree)[0]
+        for key in SHAPES:
+            x = np.asarray(tree[key], np.float64).reshape(
+                s, w, *SHAPES[key])
+            m = x.mean(1)
+            ref = np.stack([(m[i] + m[(i - 1) % s]) / 2.0
+                            for i in range(s)])
+            got = np.asarray(out[key], np.float64).reshape(
+                s, w, *SHAPES[key])
+            assert np.allclose(got, ref[:, None], atol=1e-5), key
+
+    def test_resident_rows_gather_to_the_replicated_output(self):
+        # PR 11 composition: the resident program ends at the inner
+        # scatter; gathering its rows over the data axis reproduces the
+        # replicated program's output bit-for-bit, per slice
+        mesh = slice_mesh(2, 4)
+        tree = stacked_tree(8)
+        rep = comms.make_hier_host_sync(
+            mesh, topology="ring", bucket_bytes=TINY_BUCKET)(tree)[0]
+        res = comms.make_hier_host_sync(
+            mesh, topology="ring", bucket_bytes=TINY_BUCKET,
+            residency="resident")(tree)[0]
+        gathered = comms.make_resident_gather(
+            mesh, per_worker_struct(tree), bucket_bytes=TINY_BUCKET)(res)
+        assert trees_equal(rep, gathered)
+        # and the resident state is exactly 1/W per worker: each row is
+        # padded/W elements of the padded consensus vector
+        plan = comms.bucket_plan(
+            list(per_worker_struct(tree).values()), 4, TINY_BUCKET)
+        for i, b in enumerate(plan):
+            rows = np.asarray(res[comms._bucket_name(i)])
+            assert rows.shape == (8, b.padded // 4)
+
+    def test_weighted_one_slice_limit_form(self):
+        # the weighted blend's 1-slice limit IS the flat weighted
+        # allreduce: w*own + (1-w)*(total-own)/(n-1) — checked against
+        # the flat engine on the same worker count
+        mesh_flat = mesh_lib.build_mesh({"data": 4},
+                                        devices=jax.devices()[:4])
+        tree = stacked_tree(4)
+        flat = comms.make_host_sync(
+            mesh_flat, mode="sharded", how="weighted",
+            local_weight=0.3, bucket_bytes=TINY_BUCKET)(tree)[0]
+        # hierarchical weighted with S=1 is not a built engine path
+        # (config resolves flat at 1 slice); evaluate the REFERENCE
+        # expression instead: g == m at S=1, so out = w*x +
+        # (1-w)*(W*m - x)/(W-1)
+        m = {k: np.asarray(tree[k], np.float64).mean(0) for k in SHAPES}
+        for key in SHAPES:
+            x = np.asarray(tree[key], np.float64)
+            want = 0.3 * x + 0.7 * (4 * m[key][None] - x) / 3
+            assert np.allclose(np.asarray(flat[key], np.float64), want,
+                               atol=1e-5), key
+
+
+# --------------------------------------------------------------------------
+# Wire-byte accounting (ISSUE 13 satellite — the exactness twin also
+# rides tests/test_sync.py's accounting class)
+# --------------------------------------------------------------------------
+class TestHierWireBytes:
+    def tree(self):
+        return {k: jax.ShapeDtypeStruct(v, jnp.float32)
+                for k, v in SHAPES.items()}
+
+    @pytest.mark.parametrize("topo,hops", [("ring", 1),
+                                           ("double_ring", 2)])
+    def test_dcn_bytes_exactly_shard_rows_per_hop(self, topo, hops):
+        w = 4
+        split = comms.hier_wire_bytes(self.tree(), w, topology=topo,
+                                      bucket_bytes=TINY_BUCKET)
+        plan = comms.bucket_plan(list(self.tree().values()), w,
+                                 TINY_BUCKET)
+        assert split["dcn"] == hops * sum(
+            (b.padded // w) * 4 for b in plan)
+        # inner bytes: unchanged from the flat sharded engine at W
+        assert split["ici"] == comms.sync_wire_bytes(
+            self.tree(), w, mode="sharded", wire_dtype=jnp.float32,
+            bucket_bytes=TINY_BUCKET)
+
+    def test_dcn_is_one_over_n_inner_of_flat_gossip(self):
+        # W-divisible leaves => no padding => the ratio is EXACT
+        tree = {"a": jax.ShapeDtypeStruct((64, 4), jnp.float32),
+                "b": jax.ShapeDtypeStruct((128,), jnp.float32)}
+        for w in (2, 4):
+            for topo in ("ring", "double_ring"):
+                split = comms.hier_wire_bytes(
+                    tree, w, topology=topo, bucket_bytes=TINY_BUCKET)
+                flat = comms.sync_wire_bytes(
+                    tree, 8, mode="gossip", wire_dtype=jnp.float32,
+                    bucket_bytes=TINY_BUCKET, topology=topo)
+                assert split["dcn"] * w == flat, (w, topo)
+
+    def test_compressed_outer_wire_halves_and_quarters(self):
+        fp32 = comms.hier_wire_bytes(self.tree(), 4, topology="ring",
+                                     bucket_bytes=TINY_BUCKET)
+        bf16 = comms.hier_wire_bytes(self.tree(), 4, topology="ring",
+                                     outer_wire_dtype=jnp.bfloat16,
+                                     bucket_bytes=TINY_BUCKET)
+        int8 = comms.hier_wire_bytes(self.tree(), 4, topology="ring",
+                                     outer_wire_dtype=jnp.int8,
+                                     bucket_bytes=TINY_BUCKET)
+        assert bf16["dcn"] * 2 == fp32["dcn"]
+        assert int8["dcn"] * 4 == fp32["dcn"]
+        # outer wire leaves the inner level untouched
+        assert bf16["ici"] == fp32["ici"] == int8["ici"]
+
+
+# --------------------------------------------------------------------------
+# Per-level error feedback
+# --------------------------------------------------------------------------
+class TestHierEF:
+    def test_engine_arms_ef_per_level(self):
+        model = get_model("mlp", num_classes=10, hidden=8)
+        mesh = slice_mesh(2, 2)
+        e = LocalSGDEngine(model, mesh, hier_cfg(
+            sync_dtype_outer="int8", sync_compression="ef"))
+        assert not e.sync_ef and e.sync_ef_outer
+        e = LocalSGDEngine(model, mesh, hier_cfg(
+            sync_dtype="bfloat16", sync_compression="ef"))
+        assert e.sync_ef and e.sync_ef_outer   # outer inherits bf16
+        e = LocalSGDEngine(model, mesh, hier_cfg(
+            sync_dtype="bfloat16", sync_dtype_outer="float32",
+            sync_compression="ef"))
+        assert e.sync_ef and not e.sync_ef_outer
+
+    def test_outer_ef_single_sync_drift_and_residual(self):
+        s, w = 2, 4
+        mesh = slice_mesh(s, w)
+        tree = stacked_tree(s * w)
+        ref = comms.make_hier_host_aggregator(mesh, topology="ring")(tree)
+        ores = comms.hier_outer_residual_init(
+            per_worker_struct(tree), w, s * w, bucket_bytes=TINY_BUCKET)
+        out, _res, nores = comms.make_hier_host_sync(
+            mesh, topology="ring", outer_wire_dtype=jnp.bfloat16,
+            bucket_bytes=TINY_BUCKET)(tree, None, ores)
+        err = max(float(np.abs(np.asarray(out[k], np.float32)
+                               - np.asarray(ref[k], np.float32)).max())
+                  for k in SHAPES)
+        assert 0 < err < 0.05   # one bf16 rounding of the neighbor term
+        assert any(float(np.abs(np.asarray(v)).max()) > 0
+                   for v in jax.tree_util.tree_leaves(nores))
+
+    def test_outer_ef_time_average_tracks_fp32(self):
+        # drifting-consensus regime: with EF the int8-outer iterate's
+        # time average stays near the fp32 path where the uncompensated
+        # wire's rounding bias accumulates
+        s, w = 2, 2
+        mesh = slice_mesh(s, w)
+        rng = np.random.default_rng(0)
+        base = jnp.asarray(rng.normal(size=(4, 256)) * 50, jnp.float32)
+        step = jnp.asarray(rng.uniform(0.01, 0.03, (4, 256)), jnp.float32)
+        ref_fn = comms.make_hier_host_aggregator(mesh, topology="ring")
+        comp_fn = comms.make_hier_host_sync(
+            mesh, topology="ring", outer_wire_dtype=jnp.int8,
+            bucket_bytes=TINY_BUCKET)
+        tmpl = per_worker_struct({"p": base})
+        add = jax.jit(lambda t: {"p": t["p"] + step})
+        p_ref = p_ef = p_raw = {"p": base}
+        r_ef = comms.hier_outer_residual_init({"p": tmpl["p"]}, w, s * w,
+                                              bucket_bytes=TINY_BUCKET)
+        err_ef = err_raw = 0.0
+        rounds = 30
+        for _ in range(rounds):
+            p_ref = jax.block_until_ready(ref_fn(add(p_ref)))
+            out, _r, r_ef = comp_fn(add(p_ef), None, r_ef)
+            p_ef = jax.block_until_ready(out)
+            p_raw = jax.block_until_ready(
+                comp_fn(add(p_raw))[0])
+            err_ef += float(np.abs(np.asarray(p_ef["p"])
+                                   - np.asarray(p_ref["p"])).mean())
+            err_raw += float(np.abs(np.asarray(p_raw["p"])
+                                    - np.asarray(p_ref["p"])).mean())
+        assert err_ef < err_raw, (err_ef, err_raw)
+
+
+# --------------------------------------------------------------------------
+# Engine-level rounds on the hierarchical mesh
+# --------------------------------------------------------------------------
+def make_packs(n, steps=4, b=8, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, steps, b, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, (n, steps, b)).astype(np.int32)
+    m = np.ones((n, steps, b), np.float32)
+    return x, y, m
+
+
+class TestHierEngineRound:
+    def _round(self, mesh, cfg, n):
+        model = get_model("mlp", num_classes=10, hidden=16)
+        eng = LocalSGDEngine(model, mesh, cfg)
+        x, y, m = make_packs(n)
+        st = eng.init_state(jax.random.key(0), x[0, 0])
+        st, mx = eng.round(st, (x, y, m), (x, y, m))
+        return eng, st, mx
+
+    def test_weights_round_is_gossip_of_means_of_presync_params(self):
+        # gradients mode leaves params untouched by the sync (reference
+        # aggregate-and-discard semantics), so its post-round params ARE
+        # the pre-sync per-worker params of the identically-seeded
+        # weights-mode round — the engine-level bitwise gate applies the
+        # dense gossip-of-means reference to them
+        mesh = slice_mesh(2, 2)
+        _, st_pre, mx_g = self._round(
+            mesh, hier_cfg(aggregation_by="gradients"), 4)
+        assert float(np.asarray(mx_g["agg_grad_norm"]).ravel()[0]) > 0
+        eng, st_w, _ = self._round(
+            mesh, hier_cfg(param_residency="replicated"), 4)
+        assert eng.sync_mode == "hier"
+        assert st_w.params is not None
+        ref = comms.make_hier_host_aggregator(
+            mesh, topology="ring")(st_pre.params)
+        assert trees_equal(ref, st_w.params)
+
+    def test_round_telemetry_carries_per_level_split(self):
+        mesh = slice_mesh(2, 2)
+        eng, _st, _mx = self._round(mesh, hier_cfg(), 4)
+        stats = eng.last_sync_stats
+        assert stats["sync_mode"] == "hier"
+        split = comms.hier_wire_bytes(
+            eng.params_template, 2, topology="ring",
+            wire_dtype=jnp.float32, outer_wire_dtype=jnp.float32,
+            bucket_bytes=eng.sync_bucket_bytes)
+        assert stats["sync_bytes_ici"] == split["ici"]
+        assert stats["sync_bytes_dcn"] == split["dcn"]
+        assert stats["sync_bytes"] == split["ici"] + split["dcn"]
+
+    def test_resident_round_matches_replicated_twin(self):
+        mesh = slice_mesh(2, 2)
+        eng_r, st_r, _ = self._round(
+            mesh, hier_cfg(param_residency="resident"), 4)
+        assert eng_r.resident_on and st_r.params is None
+        eng_w, st_w, _ = self._round(
+            mesh, hier_cfg(param_residency="replicated"), 4)
+        vr = eng_r.rank0_variables(st_r)
+        vw = eng_w.rank0_variables(st_w)
+        assert trees_equal(vr["params"], vw["params"])
+        # per-worker resident params are exactly 1/W of the padded
+        # gathered peak (the ISSUE 13 composition contract: 1/N_inner)
+        by = eng_r.state_resident_bytes(st_r)
+        assert by["params"] * 2 == by["params_gathered_peak"]
+
+
+# --------------------------------------------------------------------------
+# Cross-slice checkpoint re-layouts (MANIFEST records slice topology)
+# --------------------------------------------------------------------------
+class TestHierCheckpoint:
+    def _engine_state(self, s, w, tmp, **cfg_kw):
+        cfg = hier_cfg(num_slices=s, checkpoint_dir=str(tmp), **cfg_kw)
+        model = get_model("mlp", num_classes=10, hidden=8)
+        eng = LocalSGDEngine(model, slice_mesh(s, w), cfg)
+        x, _y, _m = make_packs(s * w, steps=1, b=4)
+        st = eng.init_state(jax.random.key(0), x[0, 0])
+        return cfg, eng, st
+
+    def _save(self, tmp, eng, st, num_slices):
+        e = ckpt_lib.CheckpointEngine(
+            str(tmp), async_write=False,
+            metadata={"sync_bucket_mb": eng.cfg.sync_bucket_mb,
+                      "num_slices": num_slices,
+                      "param_residency": eng.param_residency})
+        e.save(eng.checkpoint_fence(st), 1)
+        e.close()
+        return e.latest_checkpoint()
+
+    def test_manifest_records_slice_topology(self, tmp_path):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import checkpoint_metadata
+        meta = checkpoint_metadata(hier_cfg(), 10, False)
+        assert meta["num_slices"] == 2
+
+    def test_same_topology_roundtrip_bitwise(self, tmp_path):
+        _cfg, eng, st = self._engine_state(2, 2, tmp_path)
+        path = self._save(tmp_path, eng, st, 2)
+        assert ckpt_lib.manifest_metadata(path)["num_slices"] == 2
+        restored, ep = ckpt_lib.restore_checkpoint(
+            path, st, params_template=eng.params_template,
+            bucket_bytes=eng.sync_bucket_bytes, num_slices=2)
+        assert ep == 1
+        assert trees_equal(st.params_resident, restored.params_resident)
+
+    def test_flat_resident_restores_into_hier_layout(self, tmp_path):
+        # a flat checkpoint is a GLOBAL consensus: every slice adopts it
+        flat_cfg = hier_cfg(num_slices=1, topology="allreduce",
+                            sync_mode="sharded")
+        model = get_model("mlp", num_classes=10, hidden=8)
+        mesh_flat = mesh_lib.build_mesh({"data": 4},
+                                        devices=jax.devices()[:4])
+        eng_f = LocalSGDEngine(model, mesh_flat, flat_cfg)
+        x, _y, _m = make_packs(4, steps=1, b=4)
+        st_f = eng_f.init_state(jax.random.key(0), x[0, 0])
+        assert eng_f.resident_on
+        path = self._save(tmp_path, eng_f, st_f, 1)
+        _cfg, eng_h, st_h = self._engine_state(2, 2, tmp_path / "h")
+        restored, _ep = ckpt_lib.restore_checkpoint(
+            path, st_h, params_template=eng_h.params_template,
+            bucket_bytes=eng_h.sync_bucket_bytes, num_slices=2)
+        # both slices carry the flat consensus: the hier engine's rank0
+        # reconstruction equals the flat one's
+        v_f = eng_f.rank0_variables(st_f)
+        v_h = eng_h.rank0_variables(eng_h.stage_state(restored))
+        assert trees_equal(v_f["params"], v_h["params"])
+
+    def test_distinct_per_slice_consensus_refuses_recount(self, tmp_path):
+        _cfg, eng, st = self._engine_state(2, 2, tmp_path)
+        # make the two slices' consensuses DIFFER (post-gossip reality):
+        # perturb slice 1's rows in every resident bucket
+        pr = {k: np.asarray(v).copy()
+              for k, v in jax.device_get(st.params_resident).items()}
+        for k in pr:
+            pr[k][2:] += 1.0
+        st = st.replace(params_resident=jax.device_put(pr))
+        st = eng.stage_state(jax.device_get(st))
+        path = self._save(tmp_path, eng, st, 2)
+        flat_cfg = hier_cfg(num_slices=1, topology="allreduce",
+                            sync_mode="sharded")
+        mesh_flat = mesh_lib.build_mesh({"data": 4},
+                                        devices=jax.devices()[:4])
+        model = get_model("mlp", num_classes=10, hidden=8)
+        eng_f = LocalSGDEngine(model, mesh_flat, flat_cfg)
+        x, _y, _m = make_packs(4, steps=1, b=4)
+        st_f = eng_f.init_state(jax.random.key(0), x[0, 0])
+        with pytest.raises(ValueError, match="cannot re-shard"):
+            ckpt_lib.restore_checkpoint(
+                path, st_f, params_template=eng_f.params_template,
+                bucket_bytes=eng_f.sync_bucket_bytes, num_slices=1)
+
+    def test_hier_resident_restores_into_replicated_per_slice(
+            self, tmp_path):
+        _cfg, eng, st = self._engine_state(2, 2, tmp_path)
+        pr = {k: np.asarray(v).copy()
+              for k, v in jax.device_get(st.params_resident).items()}
+        for k in pr:
+            pr[k][2:] += 1.0
+        st = eng.stage_state(
+            jax.device_get(st).replace(params_resident=pr))
+        path = self._save(tmp_path, eng, st, 2)
+        # replicated template on the same hier mesh: every worker row
+        # must carry ITS OWN slice's consensus
+        _c2, eng_rep, st_rep = self._engine_state(
+            2, 2, tmp_path / "r", param_residency="replicated")
+        restored, _ep = ckpt_lib.restore_checkpoint(
+            path, st_rep, params_template=eng_rep.params_template,
+            bucket_bytes=eng_rep.sync_bucket_bytes, num_slices=2)
+        for leaf in jax.tree_util.tree_leaves(restored.params):
+            arr = np.asarray(leaf)
+            # rows agree within each slice group...
+            assert np.array_equal(arr[0], arr[1])
+            assert np.array_equal(arr[2], arr[3])
+            # ...and differ across the groups (the +1 perturbation)
+            assert not np.array_equal(arr[0], arr[2])
+
+    def test_serve_loads_slice0_consensus_from_hier_resident(
+            self, tmp_path):
+        # the serve consumer's rank-0 convention on a hierarchical
+        # resident checkpoint: slice 0's consensus, template-free from
+        # the manifest metadata (ISSUE 13 x the PR 12 serve satellite)
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import checkpoint_metadata
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.serve import engine as serve_engine
+        cfg, eng, st = self._engine_state(2, 2, tmp_path)
+        meta = checkpoint_metadata(cfg, 10, False,
+                                   param_residency=eng.param_residency,
+                                   params_template=eng.params_template)
+        e = ckpt_lib.CheckpointEngine(str(tmp_path), async_write=False,
+                                      metadata=meta)
+        e.save(eng.checkpoint_fence(st), 1)
+        e.close()
+        path = e.latest_checkpoint()
+        got = serve_engine.load_params_resident(
+            path, ckpt_lib.manifest_metadata(path))
+        assert trees_equal(got, eng.rank0_variables(st)["params"])
+
+    def test_missing_outer_residual_restores_zeros(self, tmp_path):
+        # pre-ISSUE-13 checkpoint (no outer residual) into an
+        # outer-EF-armed run: fresh zero rows, like absent round_opt
+        _cfg, eng, st = self._engine_state(2, 2, tmp_path)
+        path = self._save(tmp_path, eng, st, 2)
+        _c2, eng_ef, st_ef = self._engine_state(
+            2, 2, tmp_path / "ef", sync_dtype_outer="int8",
+            sync_compression="ef")
+        assert st_ef.sync_residual_outer is not None
+        restored, _ep = ckpt_lib.restore_checkpoint(
+            path, st_ef, params_template=eng_ef.params_template,
+            bucket_bytes=eng_ef.sync_bucket_bytes, num_slices=2)
+        for leaf in jax.tree_util.tree_leaves(restored.sync_residual_outer):
+            assert float(np.abs(np.asarray(leaf)).max()) == 0.0
+
+
+# --------------------------------------------------------------------------
+# Driver-level S x W sweeps — slow-marked up front per the ROADMAP
+# tier-1 wall-headroom rule (the sanitized 2x2 CLI smoke lives in
+# tools/verify.sh)
+# --------------------------------------------------------------------------
+@pytest.mark.slow
+class TestHierDriverMatrix:
+    def _run(self, **kw):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+        base = dict(epochs_local=1, epochs_global=3, num_workers=2,
+                    limit_train_samples=256, limit_eval_samples=64,
+                    sanitize=True)
+        base.update(kw)
+        return train_global(hier_cfg(**base), progress=False)
+
+    @pytest.mark.parametrize("layout", LAYOUTS)
+    @pytest.mark.parametrize("topo", ["ring", "double_ring"])
+    def test_sanitized_driver_layout_matrix(self, layout, topo):
+        s, w = layout
+        res = self._run(num_slices=s, num_workers=w, topology=topo)
+        san = res["sanitize"]
+        assert san["retrace_count"] == 0
+        assert san["recompile_count"] == 0
+        assert san["transfer_guard_violations"] == 0
+        assert res["sync_engine"]["mode"] == "hier"
+        assert res["sync_engine"]["num_slices"] == s
+        assert res["round_timings"][1]["sync_bytes_dcn"] > 0
+
+    def test_streamed_round_matches_packed(self):
+        # the streamed path shares the standalone donated sync program
+        # (and, resident, the slice-aware enter gather) — its hier
+        # trajectory must match the packed round's exactly
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+        kw = dict(epochs_local=1, epochs_global=2, num_workers=2,
+                  limit_train_samples=256, limit_eval_samples=64,
+                  batch_size=8)
+        packed = train_global(hier_cfg(**kw), progress=False)
+        streamed = train_global(hier_cfg(stream_chunk_steps=2, **kw),
+                                progress=False)
+        np.testing.assert_allclose(streamed["global_train_losses"],
+                                   packed["global_train_losses"],
+                                   rtol=1e-5)
+        assert streamed["sync_engine"]["mode"] == "hier"
+
+    @pytest.mark.parametrize("how", ["equal", "weighted"])
+    def test_driver_equal_weighted_consensus(self, how):
+        res = self._run(aggregation_type=how,
+                        local_weight=0.4 if how == "weighted" else 0.5)
+        assert res["sanitize"]["retrace_count"] == 0
+        assert np.isfinite(res["global_val_losses"]).all()
+
+    def test_driver_compressed_dcn_wire_with_ef(self):
+        res = self._run(sync_dtype_outer="int8", sync_compression="ef")
+        rt = res["round_timings"][1]
+        fp = self._run()
+        assert rt["sync_bytes_dcn"] * 4 == \
+            fp["round_timings"][1]["sync_bytes_dcn"]
+        assert rt["sync_bytes_ici"] == \
+            fp["round_timings"][1]["sync_bytes_ici"]

@@ -606,3 +606,63 @@ class TestBuddyWireAccounting:
         assert expect > 0
         assert on.last_sync_stats["sync_bytes"] == \
             off.last_sync_stats["sync_bytes"] + expect
+
+
+class TestHierWireAccountingInEngine:
+    """ISSUE 13 satellite: exact per-LEVEL byte accounting through the
+    ENGINE's telemetry arming — outer (DCN) bytes are exactly
+    ``hops x filled_bucket_row`` in the outer wire dtype (the gossip
+    hop rides the 1/N_inner scatter shard, never the full tree), inner
+    (ICI) bytes unchanged from the flat sharded engine at W workers.
+    The comms-level exactness matrix lives in tests/test_hier_sync.py;
+    flat engines report every byte as the ICI level with zero DCN."""
+
+    def _engine(self, s, w, **cfg_kw):
+        cfg_kw.setdefault("aggregation_by", "weights")
+        cfg_kw.setdefault("topology", "ring" if s > 1 else "allreduce")
+        cfg = Config(model="mlp", batch_size=8, compute_dtype="float32",
+                     augment=False, num_slices=s, **cfg_kw)
+        mesh = (mesh_lib.build_mesh({"slice": s, "data": w},
+                                    devices=jax.devices()[:s * w])
+                if s > 1 else sub_mesh(w))
+        eng = LocalSGDEngine(get_model("mlp", num_classes=10, hidden=8),
+                             mesh, cfg)
+        state = eng.init_state(
+            jax.random.key(0), np.zeros((8, 28, 28, 1), np.float32))
+        eng._arm_sync_stats(state.params)
+        return eng
+
+    @pytest.mark.parametrize("topology,hops", [("ring", 1),
+                                               ("double_ring", 2)])
+    def test_dcn_bytes_exactly_hops_times_shard_row(self, topology, hops):
+        eng = self._engine(2, 4, topology=topology)
+        stats = eng.last_sync_stats
+        plan = comms.bucket_plan(
+            jax.tree_util.tree_leaves(eng.params_template), 4,
+            eng.sync_bucket_bytes)
+        expect_dcn = hops * sum((b.padded // 4) * 4 for b in plan)
+        expect_ici = comms.sync_wire_bytes(
+            eng.params_template, 4, mode="sharded",
+            wire_dtype=jnp.float32, bucket_bytes=eng.sync_bucket_bytes)
+        assert stats["sync_bytes_dcn"] == expect_dcn
+        assert stats["sync_bytes_ici"] == expect_ici
+        assert stats["sync_bytes"] == expect_ici + expect_dcn
+
+    def test_compressed_outer_wire_quarters_dcn_only(self):
+        fp = self._engine(2, 2, topology="ring")
+        q = self._engine(2, 2, topology="ring", sync_dtype_outer="int8")
+        assert q.last_sync_stats["sync_bytes_dcn"] * 4 == \
+            fp.last_sync_stats["sync_bytes_dcn"]
+        assert q.last_sync_stats["sync_bytes_ici"] == \
+            fp.last_sync_stats["sync_bytes_ici"]
+
+    def test_flat_engines_report_zero_dcn(self):
+        for kw in (dict(sync_mode="sharded", topology="allreduce"),
+                   dict(sync_mode="sharded", topology="ring"),
+                   dict(sync_mode="dense", topology="allreduce")):
+            eng = self._engine(1, 4, **kw)
+            stats = eng.last_sync_stats
+            assert stats["sync_bytes_dcn"] == 0
+            assert stats["sync_bytes_ici"] == stats["sync_bytes"]
+            assert stats["sync_ms_ici"] == 0.0
+            assert stats["sync_ms_dcn"] == 0.0

@@ -40,8 +40,10 @@ RULES = {
 }
 
 # Mesh axis vocabulary fallback when no mesh.py is found on the lint path.
+# "slice" is the hierarchical outer axis (ISSUE 13) — a framework-standard
+# name like the others; a discovered mesh.py overrides this set entirely.
 DEFAULT_AXIS_VOCAB = frozenset(
-    {"data", "model", "pipe", "seq", "expert", "fsdp"})
+    {"data", "model", "pipe", "seq", "expert", "fsdp", "slice"})
 
 # Call targets (dotted-suffix spellings) that make their first function
 # argument a traced root.

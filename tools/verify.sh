@@ -141,6 +141,40 @@ EOF
     exit "$grc"
   fi
 
+  # seconds-scale hierarchical-sync smoke (ISSUE 13): the --entry hier
+  # A/B (flat sharded allreduce over S*W vs the hierarchical S x W
+  # two-level program) on a 4-device virtual CPU mesh (2 slices x 2
+  # workers).  Asserts the fp32 hierarchical program stayed BITWISE the
+  # dense gossip-of-means twin, the DCN hop payload at exactly
+  # 1/N_inner of a flat gossip hop, and the compressed outer wires at
+  # exactly 1/2 (bf16) and 1/4 (int8) of the fp32 DCN bytes.
+  echo "== bench smoke: hierarchical sync entry (CPU, 2x2) =="
+  HIER_JSON=$(XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+    JAX_PLATFORMS=cpu BENCH_BUDGET_S="${BENCH_BUDGET_S:-240}" \
+    python bench.py --entry hier) || { echo "hier smoke FAILED"; exit 1; }
+  echo "$HIER_JSON"
+  python - "$HIER_JSON" <<'EOF'
+import json, sys
+out = json.loads(sys.argv[1])
+if out.get("status") == "budget_backstop":
+    sys.exit(0)  # slow host: the backstop line is the accepted outcome
+assert out["layout"] == "2x2", out
+for topo in ("ring", "double_ring"):
+    row = out[topo]
+    assert row["bitwise_hier_eq_gossip_of_means"] is True, topo
+    # the outer hop rides the 1/W scatter shard: exactly 1/2 of a flat
+    # gossip hop's payload at W=2 (the fixture pads by < 1 ppm)
+    assert abs(row["dcn_vs_flat_gossip_hop"] - 0.5) < 1e-3, topo
+    assert row["bf16"]["dcn_vs_fp32"] == 0.5, topo
+    assert row["int8"]["dcn_vs_fp32"] == 0.25, topo
+print("hier smoke OK")
+EOF
+  hrc=$?
+  if [ "$hrc" -ne 0 ]; then
+    echo "hier smoke assertions FAILED (rc=$hrc)"
+    exit "$hrc"
+  fi
+
   # seconds-scale checkpoint-engine smoke (ISSUE 5): the --entry ckpt A/B
   # (blocking vs sharded-blocking vs async) must show the async round-loop
   # stall at <= 1/5 of the blocking save wall, payload bytes per process
@@ -364,6 +398,54 @@ if ! grep -q "sanitizer clean" "$SAN_OUT"; then
 fi
 rm -rf "$SAN_DIR"
 echo "sanitize smoke OK"
+
+# Hierarchical two-level sync smoke (ISSUE 13): a sanitized 2-slice x
+# 2-worker CPU driver run — the CLI flags resolve the hier engine, the
+# nested (slice, data) round + sync programs run under the transfer
+# guard with ZERO post-warmup retraces (the all-zero sanitizer row),
+# and the per-level telemetry's DCN/ICI byte split matches the exact
+# accounting (the outer gossip hop rides the 1/W scatter shard).
+echo "== hierarchical smoke (sanitized 2-slice x 2-worker CPU driver) =="
+if ! XLA_FLAGS="--xla_force_host_platform_device_count=4" \
+    JAX_PLATFORMS=cpu python - <<'EOF'
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import config_from_args
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+from learning_deep_neural_network_in_distributed_computing_environment_tpu import comms
+
+import jax.numpy as jnp
+
+# through the CLI parser: the --num_slices / --sync_dtype_outer flag
+# plumbing is part of what this smoke pins
+cfg = config_from_args([
+    "--device", "cpu", "--sanitize", "--model", "mlp",
+    "--dataset", "mnist", "--topology", "ring", "--num_slices", "2",
+    "--num_workers", "2", "--epochs_global", "2", "--epochs_local", "1",
+    "--batch_size", "16", "--limit_train_samples", "256",
+    "--limit_eval_samples", "64", "--compute_dtype", "float32",
+    "--no_augment", "--aggregation_by", "weights", "--seed", "7",
+    "--compile_cache_dir", ""])
+res = train_global(cfg, progress=False)
+san = res["sanitize"]
+assert san == {"enabled": True, "transfer_guard_violations": 0,
+               "retrace_count": 0, "recompile_count": 0,
+               "donation_failures": 0}, san
+se = res["sync_engine"]
+assert se["mode"] == "hier" and se["num_slices"] == 2, se
+assert se["levels"] == {"inner": "sharded", "outer": "gossip"}, se
+rt = res["round_timings"][1]
+assert rt["sync_bytes_ici"] == se["sync_bytes_ici"] > 0
+assert rt["sync_bytes_dcn"] == se["sync_bytes_dcn"] > 0
+# exact byte ratio at 2 workers/slice, fp32 both levels: the inner
+# sharded engine moves 2(W-1)/W x padded = padded bytes per worker and
+# the ring hop rides the padded/W = padded/2 shard — DCN = ICI / 2
+assert rt["sync_bytes_ici"] == 2 * rt["sync_bytes_dcn"], rt
+print("hier smoke: sanitizer all-zero, DCN/ICI byte ratio exact",
+      {"ici": rt["sync_bytes_ici"], "dcn": rt["sync_bytes_dcn"]})
+EOF
+then
+  echo "hierarchical smoke FAILED"; exit 1
+fi
+echo "hierarchical smoke OK"
 
 # Chaos/elastic smoke (ISSUE 8): a 2-round sanitized CPU driver run on 4
 # simulated workers with one scripted kill AND one join at the round-1
