@@ -183,6 +183,205 @@ def aggregate(tree: PyTree, *, how: str = "equal",
     return agg
 
 
+# --------------------------------------------------------------------------
+# Simulated many-worker aggregation (ISSUE 14): the flat-primitives
+# reference path as PURE STACKED MATH — no mesh, no axis names
+# --------------------------------------------------------------------------
+# ``aggregate`` above runs inside shard_map with one real device per
+# worker; ``aggregate_sim`` runs the SAME arithmetic on worker-stacked
+# [N, ...] leaves living on a single chip (the scenario-lab engine,
+# sim.py).  The two are bitwise-identical in fp32 because every collective
+# has an exact stacked twin on XLA:
+#
+# - psum/pmean accumulate in RANK ORDER (a sequential left-fold over the
+#   participants) — ``sim_fold`` reproduces that fold with a lax.scan over
+#   the leading axis (a reassociating ``jnp.sum`` does NOT match, which is
+#   why the fold is spelled out);
+# - ppermute's receive-from-(rank - shift) is ``jnp.roll(x, shift,
+#   axis=0)`` — pure data movement, trivially bitwise;
+# - the blends are elementwise and identical by construction.
+#
+# The ``ok`` mask is the dense path's poison/validity screen reused as the
+# scenario surface: client sampling and worker dropout exclude rows from
+# the blend exactly the way a quarantined contribution is excluded, and a
+# mask of all-ones selects the unscreened VALUES (the same all_ok-select
+# construction ``aggregate`` uses; equal blends bitwise, weighted blends
+# to fp32 FMA-contraction tolerance — the masked program's extra branches
+# change LLVM's fusion context).  The parity gate never sees a mask at
+# all: scenario knobs at their defaults compile none of this machinery
+# (sim.SimEngine.scenario_on).
+
+
+def sim_fold(x: jnp.ndarray) -> jnp.ndarray:
+    """Sequential left-fold of a stacked [N, ...] array over its leading
+    axis, in row order — the stacked twin of ``lax.psum`` (XLA's
+    all-reduce accumulates participants in rank order, so ``x[0] + x[1] +
+    ... + x[N-1]`` reproduces it bitwise; asserted against the real
+    collective in tests/test_sim.py)."""
+    if x.shape[0] == 1:
+        return x[0]
+    def add(acc, row):
+        return acc + row, None
+    acc, _ = lax.scan(add, x[0], x[1:])
+    return acc
+
+
+def _sim_rows(v: jnp.ndarray, leaf: jnp.ndarray) -> jnp.ndarray:
+    """A per-worker [N] vector broadcast against a stacked [N, ...] leaf."""
+    return v.reshape(v.shape[0], *([1] * (leaf.ndim - 1)))
+
+
+def sim_wire_bytes(tree: PyTree, n: int, *, topology: str = "allreduce",
+                   wire_dtype=None) -> int:
+    """Per-worker bytes ONE simulated worker's sync WOULD move per round
+    — the ``results["sim"]`` accounting of the fabric the simulation
+    stands in for.  Per-leaf wire model: every leaf rides the fabric once
+    per hop (gossip: ``GOSSIP_HOPS``; allreduce: one injection, the dense
+    accounting), in ``wire_dtype`` when the simulated wire is compressed.
+    fp32 equals ``sync_wire_bytes(mode="dense")`` exactly."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves or n <= 1:
+        return 0
+    hops = GOSSIP_HOPS.get(topology, 1)
+    item = lambda x: (jnp.dtype(wire_dtype).itemsize
+                      if wire_dtype is not None
+                      else jnp.dtype(x.dtype).itemsize)
+    return hops * sum(_leaf_size(x) * item(x) for x in leaves)
+
+
+def aggregate_sim(tree: PyTree, *, how: str = "equal",
+                  topology: str = "allreduce", local_weight: float = 0.5,
+                  ok: jnp.ndarray | None = None, wire_dtype=None,
+                  residual: PyTree | None = None
+                  ) -> tuple[PyTree, PyTree | None]:
+    """``aggregate`` on a worker-STACKED pytree: every leaf is [N, ...]
+    and the collectives are stacked math on the leading axis (no mesh).
+
+    fp32 with no mask is BITWISE the dense reference path (the module
+    note above says why); that is the simulator's correctness gate.
+
+    ``ok`` — optional [N] per-worker contribution-validity mask (bool or
+    0/1 float): masked-out rows are excluded from every blend and the
+    survivors renormalize, mirroring ``aggregate``'s poison screen
+    row-for-row (an all-ones mask selects the unscreened values via the
+    all_ok construction).  The scenario lab drives it with the
+    client-sampling x dropout draw.
+
+    ``wire_dtype`` + ``residual`` — the simulated compressed wire
+    (bfloat16/int8) with single-stage error feedback: each worker's
+    TRANSMITTED payload is encoded per worker row (int8: per-row
+    symmetric max/127 scale), every value received from the fabric is
+    the decoded fp32 payload, own values blend exactly, and the residual
+    carries each worker's own transmission rounding into the next round
+    — the gossip engine's wire model (comms.gossip_sync), applied
+    per-leaf and extended to the allreduce topology (where the fabric's
+    reduce likewise sees only wire payloads).  The bucketed engines'
+    per-bucket scales/two-stage EF are engine artifacts the simulation
+    does not reproduce; compressed parity is semantic, not bitwise
+    (docs/ARCHITECTURE.md).  Returns ``(aggregated, new_residual)`` —
+    ``new_residual`` is None when no error feedback is armed.
+    """
+    if how not in HOWS:
+        raise ValueError(f"how must be one of {HOWS}, got {how!r}")
+    if topology not in TOPOLOGIES:
+        raise ValueError(
+            f"topology must be one of {TOPOLOGIES}, got {topology!r}")
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return tree, residual
+    n = int(leaves[0].shape[0])
+    compressed = (wire_dtype is not None
+                  and jnp.dtype(wire_dtype) != jnp.dtype(jnp.float32))
+    ef = compressed and residual is not None
+    if n == 1:
+        return tree, residual
+    w = local_weight
+    okf = okb = valid = all_ok = ok1f = ok2f = None
+    if ok is not None:
+        okf = ok.astype(jnp.float32)
+        okb = okf > 0
+        valid = jnp.maximum(sim_fold(okf), 1.0)
+        all_ok = valid >= n
+        ok1f = jnp.roll(okf, 1, axis=0)
+        if topology == "double_ring":
+            ok2f = jnp.roll(okf, 2, axis=0)
+    if compressed:
+        _, encode = _wire_codec(jnp.dtype(wire_dtype))
+        enc_rows = jax.vmap(lambda r: encode(r)[1])   # decoded payloads
+
+    def per_leaf(x: jnp.ndarray, res):
+        x32 = x.astype(jnp.float32)
+        contrib = x32 + res if ef else x32
+        if compressed:
+            dec = enc_rows(contrib)
+            new_res = contrib - dec if ef else None
+        else:
+            dec, new_res = contrib, None
+        rows = lambda v: _sim_rows(v, x)
+        xs = dec if okb is None else jnp.where(rows(okb), dec,
+                                               jnp.zeros_like(dec))
+        if topology == "allreduce":
+            if how == "equal":
+                out = jnp.broadcast_to(sim_fold(dec) / n, x.shape)
+                if okb is None:
+                    return out, new_res
+                screened = jnp.broadcast_to(sim_fold(xs) / valid, x.shape)
+                return jnp.where(all_ok, out, screened), new_res
+            total = sim_fold(xs)
+            peers_mean = (total - dec) / (n - 1)
+            out = w * x + (1.0 - w) * peers_mean
+            if okb is None:
+                return out, new_res
+            peers = jnp.maximum(valid - 1.0, 1.0)
+            screened = jnp.where(
+                rows(okb), w * x + (1.0 - w) * (total - xs) / peers,
+                jnp.broadcast_to(total / valid, x.shape))
+            return jnp.where(all_ok, out, screened), new_res
+        if topology == "ring":
+            r = jnp.roll(xs, 1, axis=0)
+            out = (x + r) / 2.0 if how == "equal" else w * x + (1.0 - w) * r
+            if okb is None:
+                return out, new_res
+            r_ok = rows(ok1f > 0)
+            both = jnp.logical_and(rows(okb), r_ok)
+            if how == "equal":
+                cnt = rows(okf + ok1f)
+                screened = jnp.where(
+                    cnt > 0, (xs + r) / jnp.maximum(cnt, 1.0), x)
+            else:
+                screened = jnp.where(both, out, jnp.where(r_ok, r, x))
+            return jnp.where(both, out, screened), new_res
+        # double_ring: blend with the two predecessors
+        r1 = jnp.roll(xs, 1, axis=0)
+        r2 = jnp.roll(xs, 2, axis=0)
+        out = (x + r1 + r2) / 3.0 if how == "equal" \
+            else w * x + ((1.0 - w) / 2.0) * (r1 + r2)
+        if okb is None:
+            return out, new_res
+        every = jnp.logical_and(rows(okb), jnp.logical_and(
+            rows(ok1f > 0), rows(ok2f > 0)))
+        cnt = rows(okf + ok1f + ok2f)
+        if how == "equal":
+            screened = jnp.where(
+                cnt > 0, (xs + r1 + r2) / jnp.maximum(cnt, 1.0), x)
+        else:
+            pc = rows(ok1f + ok2f)
+            pmean = (r1 + r2) / jnp.maximum(pc, 1.0)
+            screened = jnp.where(
+                rows(okb), jnp.where(pc > 0, w * x + (1.0 - w) * pmean, x),
+                jnp.where(pc > 0, pmean, x))
+        return jnp.where(every, out, screened), new_res
+
+    flat, treedef = jax.tree_util.tree_flatten(tree)
+    res_flat = (jax.tree_util.tree_leaves(residual) if ef
+                else [None] * len(flat))
+    outs = [per_leaf(x, r) for x, r in zip(flat, res_flat)]
+    agg = jax.tree_util.tree_unflatten(treedef, [o[0] for o in outs])
+    new_residual = (jax.tree_util.tree_unflatten(
+        treedef, [o[1] for o in outs]) if ef else None)
+    return agg, new_residual
+
+
 def _wire_codec(wdt):
     """Wire codec for one bucket's dtype: ``(quantized, encode)``.
 

@@ -321,6 +321,45 @@ class Config:
     # "timeout", counted in results["serve"]["timed_out"]) so a stuck
     # request can never pin decode slots and cache pages forever.
     serve_request_timeout: float = 0.0
+    # --- scenario lab: vmap'd many-worker simulator (ISSUE 14) -------------
+    # sim_workers: > 0 runs the ENTIRE local-SGD round for that many
+    # workers as one vmap'd, donated jit on a SINGLE chip — per-worker
+    # data slices, RNG streams and SGD/Adam state stacked on a leading
+    # [N, ...] axis (exactly the layer-scan stacking trick, applied to
+    # the worker axis), the sync point as pure stacked math
+    # (comms.aggregate_sim, the flat-primitives reference path's twin —
+    # fp32 N=8 simulated is BITWISE N=8 real-mesh rounds).  N becomes a
+    # batch dimension instead of a process count, so hundreds of workers
+    # fit where the real mesh caps at the device count.  0 = off (the
+    # real-mesh driver).  Real-mesh-only features are rejected eagerly
+    # below: elastic/chaos process semantics, buddy redundancy,
+    # multi-slice DCN, inner model axes, streamed rounds, checkpoints
+    # (v1), an explicit real-mesh worker count.
+    sim_workers: int = 0
+    # Client sampling: each round draws ceil(frac * N) participants
+    # (seeded by --seed, deterministic).  Sampled-out workers skip the
+    # round's local training and contribution but ADOPT the consensus
+    # their topology delivers (allreduce: the survivors' mean; gossip:
+    # a participating predecessor's payload) — FedAvg client sampling.
+    sim_sample_frac: float = 1.0     # (0, 1]; 1 = everyone, every round
+    # Worker dropout: each round each worker independently vanishes with
+    # this probability (seeded) — it neither trains, contributes, NOR
+    # adopts (the whole round is a no-op for it; unlike a sampled-out
+    # worker it misses the consensus too).
+    sim_dropout: float = 0.0         # [0, 1)
+    # Byzantine adversaries: "kind:count[:scale]" — the LAST `count`
+    # worker ids corrupt their sync contribution every round.  Kinds:
+    # "signflip" (weights mode: 2*entry - trained, i.e. the round's
+    # update sign-flipped; gradients mode: -grad) and "noise" (payload +
+    # scale * N(0,1), fresh seeded draw per round; scale defaults 1.0).
+    # Their LOCAL state stays honest — they adopt blends like everyone —
+    # so the knob isolates the poisoned-contribution effect.  "" = off.
+    sim_byzantine: str = ""
+    # Per-worker learning-rate jitter: worker i trains with
+    # lr * (1 + jitter * u_i), u_i a seeded uniform[-1, 1) draw fixed
+    # for the run — heterogeneous-tuning scenarios.  0 = off (the real
+    # path's arithmetic, byte-for-byte).
+    sim_lr_jitter: float = 0.0       # [0, 1)
 
     def __post_init__(self) -> None:
         _choices("backend", self.backend, ("jax", "gloo", "nccl", "mpi"))
@@ -526,6 +565,127 @@ class Config:
             raise ValueError(f"local_weight must be in [0,1], got {self.local_weight}")
         if not 0.0 <= self.fixed_ratio <= 1.0:
             raise ValueError(f"fixed_ratio must be in [0,1], got {self.fixed_ratio}")
+        # --- scenario lab (ISSUE 14): eager validation -------------------
+        if self.sim_workers < 0:
+            raise ValueError(
+                f"sim_workers must be >= 0 (0 = real-mesh driver), got "
+                f"{self.sim_workers}")
+        if not 0.0 < self.sim_sample_frac <= 1.0:
+            raise ValueError(
+                f"--sim_sample_frac must be in (0, 1] (each round samples "
+                f"ceil(frac * N) >= 1 participants), got "
+                f"{self.sim_sample_frac}")
+        if not 0.0 <= self.sim_dropout < 1.0:
+            raise ValueError(
+                f"--sim_dropout must be in [0, 1) (1.0 would drop every "
+                f"worker every round — no round could ever commit), got "
+                f"{self.sim_dropout}")
+        if not 0.0 <= self.sim_lr_jitter < 1.0:
+            raise ValueError(
+                f"--sim_lr_jitter must be in [0, 1): worker i trains at "
+                f"lr * (1 + jitter * u_i) with u_i in [-1, 1), and jitter "
+                f">= 1 could drive a learning rate to zero or negative; "
+                f"got {self.sim_lr_jitter}")
+        self.parse_sim_byzantine()   # validates the spec eagerly
+        if self.sim_workers == 0:
+            for flag, dflt, name in (
+                    (self.sim_sample_frac, 1.0, "--sim_sample_frac"),
+                    (self.sim_dropout, 0.0, "--sim_dropout"),
+                    (self.sim_byzantine, "", "--sim_byzantine"),
+                    (self.sim_lr_jitter, 0.0, "--sim_lr_jitter")):
+                if flag != dflt:
+                    raise ValueError(
+                        f"{name} is a simulated-scenario knob; it needs "
+                        "--sim_workers N (the real-mesh driver has no "
+                        "per-round participation/adversary machinery)")
+        else:
+            if self.chaos:
+                raise ValueError(
+                    "--chaos cannot combine with --sim_workers: the chaos "
+                    "harness injects faults into the REAL driver's "
+                    "process semantics (measured walls, membership "
+                    "boundaries, mesh rebuilds) which the vmap'd "
+                    "simulator replaces with stacked math — use "
+                    "--sim_dropout / --sim_byzantine for simulated "
+                    "failure scenarios")
+            if self.num_slices > 1:
+                raise ValueError(
+                    "--num_slices > 1 cannot combine with --sim_workers: "
+                    "the hierarchical sync models a real multi-slice DCN "
+                    "fabric (nested mesh axes, per-level wires) — the "
+                    "simulator's fabric is stacked math on one chip; "
+                    "simulate the flat topologies instead")
+            if self.shard_redundancy == "buddy":
+                raise ValueError(
+                    "--shard_redundancy buddy cannot combine with "
+                    "--sim_workers: buddy redundancy protects REAL "
+                    "shard-resident state against a real worker's crash "
+                    "— every simulated worker's rows already live on the "
+                    "one chip (nothing is uniquely held; auto resolves "
+                    "to off)")
+            if self.opt_placement == "sharded":
+                raise ValueError(
+                    "--opt_placement sharded cannot combine with "
+                    "--sim_workers: the shard-resident apply is a stage "
+                    "of the real bucketed sync engine (psum_scatter/"
+                    "all_gather over a real worker axis) — the simulated "
+                    "sync is the dense-semantics stacked twin "
+                    "(comms.aggregate_sim), which has no scatter phase "
+                    "to place an apply between")
+            if self.param_residency == "resident":
+                raise ValueError(
+                    "--param_residency resident cannot combine with "
+                    "--sim_workers: scatter-resident params ARE the real "
+                    "engine's 1/N scatter output kept between rounds — "
+                    "the simulated worker axis lives on one chip, where "
+                    "every row is already resident (nothing to gather)")
+            if self.sync_mode == "sharded":
+                raise ValueError(
+                    "--sync_mode sharded cannot combine with "
+                    "--sim_workers: the bucketed sharded engine runs "
+                    "real collectives over a real mesh axis — the "
+                    "simulated sync is comms.aggregate_sim, the stacked "
+                    "twin of the dense reference path (fp32 sharded is "
+                    "bitwise dense anyway, so nothing is lost)")
+            if self.stream_chunk_steps > 0:
+                raise ValueError(
+                    "--stream_chunk_steps cannot combine with "
+                    "--sim_workers in v1: the streamed round feeds "
+                    "per-chunk shard_map programs over the real worker "
+                    "axis — the simulator runs the whole-round vmap'd "
+                    "program (its pack already scales as one [N, S, B] "
+                    "stack on one chip)")
+            if self.checkpoint_dir or self.resume:
+                raise ValueError(
+                    "--checkpoint_dir/--resume cannot combine with "
+                    "--sim_workers in v1: the sharded checkpoint "
+                    "engine's layouts and manifest worker-axis "
+                    "bookkeeping describe the real mesh — simulated "
+                    "runs are cheap to replay from seed (ROADMAP names "
+                    "sim checkpointing as the follow-on)")
+            if self.num_workers:
+                raise ValueError(
+                    f"--num_workers {self.num_workers} sizes the REAL "
+                    "mesh data axis; with --sim_workers the worker axis "
+                    "is simulated on one chip — drop --num_workers (the "
+                    "simulated count is --sim_workers)")
+            inner = [a for a, s in self._mesh_shape_axes().items()
+                     if a != "data" and (s > 1 or s <= 0)]
+            if inner:
+                raise ValueError(
+                    f"--sim_workers cannot combine with inner mesh axes "
+                    f"{inner} (--mesh_shape {self.mesh_shape!r}): "
+                    "TP/PP/SP/EP/FSDP shard the parameter leaves over "
+                    "REAL devices inside each worker — the simulator "
+                    "stacks whole per-worker states on one chip "
+                    "(hierarchy inside a simulated worker is the "
+                    "ROADMAP follow-on)")
+            if self.sequence_parallel != "none":
+                raise ValueError(
+                    "--sequence_parallel cannot combine with "
+                    "--sim_workers: the ring/zigzag attention kernels "
+                    "run over a real 'seq' mesh axis (see the inner-"
+                    "mesh-axes rejection)")
 
     # Convenience ----------------------------------------------------------
     def replace(self, **kw: Any) -> "Config":
@@ -702,6 +862,73 @@ class Config:
                 "kinds — a random schedule needs at least one")
         return tuple(out)
 
+    SIM_BYZANTINE_KINDS = ("signflip", "noise")
+
+    def parse_sim_byzantine(self) -> tuple[str, int, float] | None:
+        """``--sim_byzantine`` as ``(kind, count, scale)`` or None.
+
+        Spec: ``kind:count[:scale]`` with kind in
+        ``SIM_BYZANTINE_KINDS``, count >= 1 adversarial workers (the
+        LAST count worker ids), scale the noise stddev (noise kind only;
+        default 1.0).  Validated eagerly like parse_chaos_kinds — a
+        typo'd adversary spec fails at argparse time, not mid-sweep."""
+        spec = self.sim_byzantine.strip()
+        if not spec:
+            return None
+        parts = spec.split(":")
+        if len(parts) not in (2, 3):
+            raise ValueError(
+                f"--sim_byzantine must be 'kind:count[:scale]', got "
+                f"{self.sim_byzantine!r}")
+        kind = parts[0].strip()
+        if kind not in self.SIM_BYZANTINE_KINDS:
+            raise ValueError(
+                f"unknown --sim_byzantine kind {kind!r}: expected one of "
+                f"{self.SIM_BYZANTINE_KINDS}")
+        try:
+            count = int(parts[1])
+        except ValueError:
+            raise ValueError(
+                f"--sim_byzantine count must be an integer, got "
+                f"{parts[1]!r} in {self.sim_byzantine!r}") from None
+        if count < 1:
+            raise ValueError(
+                f"--sim_byzantine count must be >= 1, got {count}")
+        if self.sim_workers and count >= self.sim_workers:
+            raise ValueError(
+                f"--sim_byzantine count {count} must leave at least one "
+                f"honest worker (--sim_workers {self.sim_workers})")
+        scale = 1.0
+        if len(parts) == 3:
+            if kind != "noise":
+                raise ValueError(
+                    f"--sim_byzantine scale applies to the 'noise' kind "
+                    f"(the injected stddev); {kind!r} takes none — got "
+                    f"{self.sim_byzantine!r}")
+            try:
+                scale = float(parts[2])
+            except ValueError:
+                raise ValueError(
+                    f"--sim_byzantine scale must be a float, got "
+                    f"{parts[2]!r} in {self.sim_byzantine!r}") from None
+            if scale <= 0:
+                raise ValueError(
+                    f"--sim_byzantine noise scale must be > 0, got "
+                    f"{scale}")
+        return (kind, count, scale)
+
+    def _mesh_shape_axes(self) -> dict[str, int]:
+        """Raw ``--mesh_shape`` parse (no slice-axis logic) — the sim
+        validation reads it before ``mesh_axes``'s hierarchical checks."""
+        axes: dict[str, int] = {}
+        for part in self.mesh_shape.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, size = part.partition("=")
+            axes[name.strip()] = int(size) if size else -1
+        return axes
+
     def parse_prompt_buckets(self) -> tuple[int, ...]:
         """``--serve_prompt_buckets`` as ascending unique lengths."""
         out = []
@@ -733,13 +960,7 @@ class Config:
         slices (the v1 composition limit: hierarchical sync x
         TP/PP/SP/EP/FSDP needs per-device bucket plans — follow-on).
         """
-        axes: dict[str, int] = {}
-        for part in self.mesh_shape.split(","):
-            part = part.strip()
-            if not part:
-                continue
-            name, _, size = part.partition("=")
-            axes[name.strip()] = int(size) if size else -1
+        axes = self._mesh_shape_axes()
         if "slice" in axes:
             raise ValueError(
                 "the 'slice' mesh axis is driven by --num_slices, not "
@@ -1043,6 +1264,33 @@ def build_argparser() -> argparse.ArgumentParser:
                         "zero-retrace budget after the warmup round, and "
                         "donated-buffer deletion asserts (also via "
                         "JAX_GRAFT_SANITIZE=1)")
+    # --- scenario lab group (ISSUE 14) -------------------------------------
+    p.add_argument("--sim_workers", type=int, default=d.sim_workers,
+                   help="simulate this many local-SGD workers as one "
+                        "vmap'd jit on a SINGLE chip (per-worker state/"
+                        "data/RNG stacked on a leading axis; sync = "
+                        "stacked math, fp32 bitwise vs the real mesh at "
+                        "equal N); 0 = the real-mesh driver")
+    p.add_argument("--sim_sample_frac", type=float,
+                   default=d.sim_sample_frac,
+                   help="scenario: per-round client sampling — each "
+                        "round ceil(frac*N) seeded-drawn workers train "
+                        "and contribute; the rest skip the round but "
+                        "adopt the consensus (FedAvg sampling)")
+    p.add_argument("--sim_dropout", type=float, default=d.sim_dropout,
+                   help="scenario: per-round worker dropout probability "
+                        "— a dropped worker neither trains, contributes, "
+                        "nor adopts (the whole round is a no-op for it)")
+    p.add_argument("--sim_byzantine", type=str, default=d.sim_byzantine,
+                   help="scenario: adversarial workers, "
+                        "'kind:count[:scale]' — the last count ids "
+                        "corrupt their sync contribution every round "
+                        "(signflip = the round's update sign-flipped; "
+                        "noise = payload + scale*N(0,1), seeded)")
+    p.add_argument("--sim_lr_jitter", type=float, default=d.sim_lr_jitter,
+                   help="scenario: per-worker LR spread — worker i "
+                        "trains at lr*(1 + jitter*u_i), u_i a seeded "
+                        "uniform[-1,1) draw fixed for the run")
     return p
 
 

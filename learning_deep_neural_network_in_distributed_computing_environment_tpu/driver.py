@@ -283,6 +283,28 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         if not san_counter_ok:
             log.warning("sanitizer: trace/compile monitoring unavailable "
                         "on this jax — the retrace budget is not enforced")
+    # --- scenario lab (ISSUE 14) ---------------------------------------
+    # --sim_workers N simulates the whole worker axis as one vmap'd jit
+    # on a single chip (sim.SimEngine); the orchestration loop below is
+    # the SAME — probe, partition, straggler EMA, sanitizer, telemetry
+    # all run per simulated worker.  Real-mesh-only features were
+    # rejected at config time (chaos, slices, buddy, streaming, inner
+    # axes, checkpoints); the two driver-level inputs that bypass config
+    # are rejected here.
+    sim_on = cfg.sim_workers > 0
+    if sim_on:
+        if elastic_snapshot is not None:
+            raise ValueError(
+                "elastic_snapshot cannot combine with --sim_workers: "
+                "membership snapshots describe the REAL worker axis "
+                "(mesh rebuilds, row-edited device state) — simulated "
+                "membership scenarios are --sim_sample_frac / "
+                "--sim_dropout")
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "--sim_workers is single-process by construction: the "
+                "simulated worker axis lives on one chip (that is the "
+                "point) — run multi-process fleets on the real driver")
     # --- elastic membership + chaos harness (ISSUE 8) ------------------
     # The chaos schedule is pure data keyed by absolute round index; the
     # straggler policy (retry/timeout/backoff around the round sync) is
@@ -319,12 +341,24 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             "(--chaos is likewise rejected at config time) — per-slice "
             "membership is the ROADMAP follow-on")
     if mesh is None:
-        axes = cfg.mesh_axes()
-        if cfg.num_workers:
-            axes[DATA_AXIS] = cfg.num_workers
-        if elastic_snapshot is not None:
-            axes[DATA_AXIS] = elastic_snapshot.n_workers
-        mesh = build_mesh(axes)
+        if sim_on:
+            # ONE anchor device hosts the whole simulated worker grid —
+            # the remaining devices are deliberately unused (the
+            # capability being demonstrated: N no longer costs devices)
+            mesh = build_mesh({DATA_AXIS: 1}, devices=jax.devices()[:1])
+        else:
+            axes = cfg.mesh_axes()
+            if cfg.num_workers:
+                axes[DATA_AXIS] = cfg.num_workers
+            if elastic_snapshot is not None:
+                axes[DATA_AXIS] = elastic_snapshot.n_workers
+            mesh = build_mesh(axes)
+    elif sim_on and world_size(mesh) != 1:
+        raise ValueError(
+            f"--sim_workers runs the whole worker grid on ONE anchor "
+            f"device; got a {world_size(mesh)}-worker mesh — pass no "
+            "mesh (the driver builds the 1-device anchor) or a "
+            "1-device data mesh")
     elif (elastic_snapshot is not None
           and mesh.shape[DATA_AXIS] != elastic_snapshot.n_workers):
         # the caller's mesh predates the membership change; rebuild the
@@ -338,8 +372,12 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             "mesh from cfg.mesh_axes() (or pass none and let the driver)")
     # TOTAL worker count — slices x workers-per-slice on a hierarchical
     # mesh (ISSUE 13); every partition, pack, metric row, and RNG stream
-    # below is per total worker, exactly as before at 1 slice
-    n = world_size(mesh)
+    # below is per total worker, exactly as before at 1 slice.  In
+    # simulated mode (ISSUE 14) the worker axis is --sim_workers wide
+    # regardless of the 1-device anchor mesh — every per-worker
+    # structure below (partitions, packs, probe vector, metric rows,
+    # RNG streams) is per SIMULATED worker.
+    n = cfg.sim_workers if sim_on else world_size(mesh)
     if jax.process_count() > 1 and n % jax.process_count():
         # validate once at setup: probe-duration and wall-time attribution
         # both need whole worker-row blocks per process (probe.py,
@@ -692,9 +730,15 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         train_kw.update(attention_impl=cfg.attention_impl)
     if train_kw:
         train_model = build_model_for(cfg, num_classes, **base_kw, **train_kw)
-    engine = LocalSGDEngine(model, mesh, cfg, train_model=train_model,
-                            param_specs_fn=param_specs_fn,
-                            nan_screen=nan_armed)
+    if sim_on:
+        # param_specs_fn / nan_screen are real-mesh machinery (inner
+        # axes and --chaos were both rejected at config time)
+        from .sim import SimEngine
+        engine = SimEngine(model, mesh, cfg, train_model=train_model)
+    else:
+        engine = LocalSGDEngine(model, mesh, cfg, train_model=train_model,
+                                param_specs_fn=param_specs_fn,
+                                nan_screen=nan_armed)
     # the engine resolution is per topology (Config.resolve_sync_mode):
     # bucketed reduce-scatter for allreduce, bucketed ppermute gossip for
     # ring/double_ring, legacy per-leaf dense otherwise — surfaced here
@@ -854,8 +898,12 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
             # per-LEVEL resolution (ISSUE 13): inner = the ICI engine,
             # outer = the DCN engine (None on flat runs) — plus the
             # static per-round wire-byte split, filled after the first
-            # round arms the accounting (zeros when no round ran)
-            "levels": cfg.resolve_sync_levels(jax.default_backend()),
+            # round arms the accounting (zeros when no round ran).
+            # Simulated runs (ISSUE 14) report the one "sim" level: the
+            # whole fabric is stacked math on one chip.
+            "levels": ({"inner": "sim", "outer": None} if sim_on
+                       else cfg.resolve_sync_levels(
+                           jax.default_backend())),
             "num_slices": engine.n_slices,
             "sync_bytes_ici": 0,
             "sync_bytes_dcn": 0,
@@ -1814,6 +1862,19 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                  len(el["events"]), len(el["rejected"]),
                  len(el["sync_retries"]), el["reshard_ms"],
                  el["rounds_degraded"], el["final_worker_ids"])
+
+    # scenario-lab provenance (ISSUE 14): recorded like sync_engine /
+    # sanitize — a simulated run's artifact states the simulated scale,
+    # measured rounds/s, per-worker bytes (state + what one worker's
+    # sync would move on the simulated fabric), and the scenario draws
+    if sim_on:
+        results["sim"] = engine.sim_summary(results["round_timings"],
+                                            state)
+        log.info("scenario lab: %d simulated workers on one chip, "
+                 "%s rounds/s, %d bytes/worker sync wire",
+                 results["sim"]["workers"],
+                 results["sim"]["rounds_per_s"],
+                 results["sim"]["per_worker_sync_bytes"])
 
     results["state"] = state
     # the rank-0 eval variables, residency-agnostic (ISSUE 11): a

@@ -1668,11 +1668,85 @@ class LocalSGDEngine:
 
         return train_step, eval_step
 
-    def _build_round(self, shapes_key):
+    def _make_local_round(self, augment: bool):
+        """Builder for the LOCAL phase of one worker's round —
+        ``epochs_local`` x (train scan + per-epoch validation scan) with
+        the StepLR clock — containing NO cross-worker collectives.
+
+        ONE definition serves two executions (ISSUE 14): the real round
+        program runs it per worker inside ``shard_map`` (``_build_round``
+        adds the avg_acc/global-metric pmeans and the sync point around
+        it), and the many-worker simulator (sim.py) ``jax.vmap``s it over
+        the stacked worker axis — hundreds of simulated workers in one
+        jit on one chip.  Keeping the body collective-free is what makes
+        the one definition serve both, and the N=8 simulated-vs-real
+        bitwise gate mechanical.
+
+        ``lr_scale`` (sim scenario surface: per-worker LR jitter)
+        multiplies the StepLR output when given; ``None`` leaves the real
+        path's arithmetic byte-for-byte untouched.
+
+        Returns ``local_round(params0, batch_stats0, opt_state0,
+        lr_epoch0, rng0, x, y, m, xv, yv, mv, lr_scale=None) ->
+        ((params, batch_stats, opt_state, lr_epoch, rng, last_grads),
+        per_epoch)`` with ``per_epoch`` the [E]-stacked dict
+        (batch_losses/batch_mask/train_loss/train_acc/val_loss/val_acc —
+        the cross-worker ``avg_acc`` is the caller's to add)."""
         cfg = self.cfg
         epochs_local = cfg.epochs_local
-        augment = cfg.augment and len(shapes_key[0]) == 5  # [S,B,H,W,C]
         train_step, eval_step = self._make_step_fns(augment)
+
+        def local_round(params0, batch_stats0, opt_state0, lr_epoch0,
+                        rng0, x, y, m, xv, yv, mv, lr_scale=None):
+            zero_grads = _zeros_like_varying(params0)
+
+            def local_epoch(carry, _):
+                params, batch_stats, opt_state, lr_epoch, rng, _ = carry
+                lr = steplr(cfg.lr, cfg.lr_gamma, cfg.lr_step_size,
+                            lr_epoch)
+                if lr_scale is not None:
+                    lr = lr * lr_scale
+                (params, batch_stats, opt_state, rng, _, last_grads), \
+                    (losses, corrects, totals) = lax.scan(
+                        train_step,
+                        (params, batch_stats, opt_state, rng, lr,
+                         zero_grads),
+                        (x, y, m))
+                # reference per-epoch scalars: loss = mean over real batches
+                # (trainer.py:220), accuracy = 100*correct/total (:221)
+                real_step = (totals > 0).astype(jnp.float32)
+                train_loss = _masked_mean(losses, real_step)
+                train_acc = 100.0 * corrects.sum() / jnp.maximum(
+                    totals.sum(), 1.0)
+                # validation on the worker's own val shard every local epoch
+                # (trainer.py:105-107); FSDP: one gather for the whole scan
+                eval_params = params
+                if self.fsdp_axis:
+                    from .parallel.fsdp import gather_params
+                    eval_params = gather_params(
+                        params, self.param_specs, self.fsdp_axis)
+                _, (vls, vcs, vts) = lax.scan(
+                    eval_step, (eval_params, batch_stats), (xv, yv, mv))
+                val_loss = vls.sum() / jnp.maximum(vts.sum(), 1.0)
+                val_acc = 100.0 * vcs.sum() / jnp.maximum(vts.sum(), 1.0)
+                lr_epoch = lr_epoch + 1
+                per_epoch = dict(
+                    batch_losses=losses, batch_mask=real_step,
+                    train_loss=train_loss, train_acc=train_acc,
+                    val_loss=val_loss, val_acc=val_acc)
+                return ((params, batch_stats, opt_state, lr_epoch, rng,
+                         last_grads), per_epoch)
+
+            carry0 = (params0, batch_stats0, opt_state0, lr_epoch0, rng0,
+                      zero_grads)
+            return lax.scan(local_epoch, carry0, None, length=epochs_local)
+
+        return local_round
+
+    def _build_round(self, shapes_key):
+        cfg = self.cfg
+        augment = cfg.augment and len(shapes_key[0]) == 5  # [S,B,H,W,C]
+        local_round = self._make_local_round(augment)
 
         # the fused (CPU) sync point screens contributions when the NaN
         # screen is armed: the round program then takes the per-worker
@@ -1696,50 +1770,18 @@ class LocalSGDEngine:
                     bucket_bytes=self.sync_bucket_bytes)
             else:
                 params0 = state.params
-            zero_grads = _zeros_like_varying(params0)
-
-            def local_epoch(carry, _):
-                params, batch_stats, opt_state, lr_epoch, rng, _ = carry
-                lr = steplr(cfg.lr, cfg.lr_gamma, cfg.lr_step_size, lr_epoch)
-                (params, batch_stats, opt_state, rng, _, last_grads), \
-                    (losses, corrects, totals) = lax.scan(
-                        train_step,
-                        (params, batch_stats, opt_state, rng, lr, zero_grads),
-                        (x, y, m))
-                # reference per-epoch scalars: loss = mean over real batches
-                # (trainer.py:220), accuracy = 100*correct/total (:221)
-                real_step = (totals > 0).astype(jnp.float32)
-                train_loss = _masked_mean(losses, real_step)
-                train_acc = 100.0 * corrects.sum() / jnp.maximum(
-                    totals.sum(), 1.0)
-                # validation on the worker's own val shard every local epoch
-                # (trainer.py:105-107); FSDP: one gather for the whole scan
-                eval_params = params
-                if self.fsdp_axis:
-                    from .parallel.fsdp import gather_params
-                    eval_params = gather_params(
-                        params, self.param_specs, self.fsdp_axis)
-                _, (vls, vcs, vts) = lax.scan(
-                    eval_step, (eval_params, batch_stats), (xv, yv, mv))
-                val_loss = vls.sum() / jnp.maximum(vts.sum(), 1.0)
-                val_acc = 100.0 * vcs.sum() / jnp.maximum(vts.sum(), 1.0)
-                # cross-worker mean accuracy per local epoch
-                # (trainer.py:50-53) — over the WHOLE worker grid:
-                # (slice, data) on a hierarchical mesh (ISSUE 13)
-                avg_acc = lax.pmean(train_acc, self._stack_axes)
-                lr_epoch = lr_epoch + 1
-                per_epoch = dict(
-                    batch_losses=losses, batch_mask=real_step,
-                    train_loss=train_loss, train_acc=train_acc,
-                    val_loss=val_loss, val_acc=val_acc, avg_acc=avg_acc)
-                return ((params, batch_stats, opt_state, lr_epoch, rng,
-                         last_grads), per_epoch)
-
-            carry0 = (params0, state.batch_stats, state.opt_state,
-                      state.lr_epoch, state.rng, zero_grads)
             (params, batch_stats, opt_state, lr_epoch, rng, last_grads), \
-                per_epoch = lax.scan(local_epoch, carry0, None,
-                                     length=epochs_local)
+                per_epoch = local_round(
+                    params0, state.batch_stats, state.opt_state,
+                    state.lr_epoch, state.rng, x, y, m, xv, yv, mv)
+            # cross-worker mean accuracy per local epoch (trainer.py:50-53)
+            # — over the WHOLE worker grid: (slice, data) on a hierarchical
+            # mesh (ISSUE 13).  Elementwise over the [E]-stacked outputs,
+            # i.e. the same per-epoch pmeans the scan used to carry,
+            # hoisted out so the local phase stays collective-free (shared
+            # with the vmap'd simulator, ISSUE 14).
+            per_epoch = dict(per_epoch, avg_acc=lax.pmean(
+                per_epoch["train_acc"], self._stack_axes))
 
             # --- the sync point (trainer.py:141-150) -----------------------
             # On CPU the sync engine (dense per-leaf, the sharded

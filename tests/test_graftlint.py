@@ -445,6 +445,36 @@ def hier_round(sync, params, residual, outer_residual):
 """
         assert "R4" not in rules_for(src)
 
+    def test_sim_stacked_state_use_after_donate_flagged(self):
+        # ISSUE 14 fixture: the simulated round program donates the
+        # whole worker-STACKED TrainState (sim.SimEngine._build_round,
+        # donate_argnums=(0,)) — with hundreds of simulated workers the
+        # stacked carry is the chip's dominant allocation, so a read of
+        # the donated input after dispatch touches freed [N, ...]
+        # buffers (and a declined donation would silently DOUBLE the
+        # state memory the whole lab exists to save)
+        src = """
+import jax
+def sim_loop(sim_round, state, x, y, m):
+    prog = jax.jit(sim_round, donate_argnums=(0,))
+    new_state, metrics = prog(state, x, y, m)
+    probe = state  # donated stacked carry read after dispatch
+    return new_state, metrics, probe
+"""
+        assert "R4" in rules_for(src)
+
+    def test_sim_stacked_state_rebound_to_output_clean(self):
+        # the engine's real shape: the caller rebinds its state name to
+        # the round's output before any further read (driver round loop)
+        src = """
+import jax
+def sim_loop(sim_round, state, x, y, m):
+    prog = jax.jit(sim_round, donate_argnums=(0,))
+    state, metrics = prog(state, x, y, m)
+    return state, metrics
+"""
+        assert "R4" not in rules_for(src)
+
     def test_rebound_name_no_longer_shard_map_clean(self):
         src = """
 import jax
@@ -710,6 +740,24 @@ def f(x):
                 "fsdp", "slice"} <= set(vocab)
         assert constants.get("DATA_AXIS") == "data"
         assert constants.get("SLICE_AXIS") == "slice"
+
+    def test_vmapped_code_without_axis_names_lints_clean(self):
+        # ISSUE 14: the simulator's whole point is that vmap'd per-worker
+        # code carries NO mesh axis names — the cross-worker reductions
+        # are stacked math (sequential fold, roll).  R3's collective-
+        # axis-name vocabulary check must have nothing to say about it.
+        src = """
+import jax
+import jax.numpy as jnp
+from jax import lax
+def sim_sync(local_round, stacked, x):
+    outs = jax.vmap(local_round)(stacked, x)
+    def add(acc, row):
+        return acc + row, None
+    folded, _ = lax.scan(add, outs[0], outs[1:])
+    return (outs + jnp.roll(outs, 1, axis=0)) / 2.0, folded
+"""
+        assert "R3" not in rules_for(src)
 
     def test_slice_axis_collectives_lint_clean(self):
         # the hierarchical program's shape: psum_scatter over the inner

@@ -290,6 +290,38 @@ EOF
     echo "recover smoke assertions FAILED (rc=$rrc)"
     exit "$rrc"
   fi
+
+  # Scenario-lab bench smoke (ISSUE 14): the --entry sim A/B must prove
+  # the tentpole gate on every sweep — fp32 N=8 simulated rounds BITWISE
+  # the N=8 real-mesh rounds — and run the N=64/256 scaling arms on ONE
+  # chip (rounds/s + per-worker bytes), the scale the real-mesh path
+  # cannot host at all.
+  echo "== bench smoke: scenario lab entry (CPU, 8 virtual devices) =="
+  SIM_JSON=$(XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    JAX_PLATFORMS=cpu BENCH_BUDGET_S="${BENCH_BUDGET_S:-300}" \
+    python bench.py --entry sim) || { echo "sim smoke FAILED"; exit 1; }
+  echo "$SIM_JSON"
+  python - "$SIM_JSON" <<'EOF'
+import json, sys
+out = json.loads(sys.argv[1])
+if out.get("status") == "budget_backstop":
+    sys.exit(0)  # slow host: the backstop line is the accepted outcome
+assert out["bitwise_sim_eq_real_mesh"] is True, out
+sc = out["scaling"]
+for n in (64, 256):
+    row = sc[f"n{n}"]
+    assert row["workers"] == n
+    assert row["rounds_per_s_warm"] > 0, row
+    assert row["per_worker_state_mb"] > 0, row
+assert out["scenario_n64"]["workers"] == 64
+print("sim smoke OK: N=8 bitwise vs real mesh; N=256 on one chip at",
+      sc["n256"]["rounds_per_s_warm"], "rounds/s")
+EOF
+  simrc=$?
+  if [ "$simrc" -ne 0 ]; then
+    echo "sim smoke assertions FAILED (rc=$simrc)"
+    exit "$simrc"
+  fi
 fi
 
 # Checkpoint kill-mid-write -> resume smoke (ISSUE 5 satellite): phase A
@@ -556,6 +588,44 @@ if [ "$rc" -ne 0 ]; then
   echo "crash bitwise-tail smoke FAILED (rc=$rc)"
   exit "$rc"
 fi
+
+# Scenario-lab smoke (ISSUE 14), CLI edition: a sanitized 2-round
+# simulated driver run through config_from_args — the --sim_* flag
+# plumbing resolves the SimEngine, the vmap'd round + stacked sync run
+# under the transfer guard with ZERO post-warmup retraces (the all-zero
+# sanitizer row), the donated stacked state passes the deletion asserts,
+# and the run artifact carries the sim provenance (mode "sim",
+# per-worker wire accounting).
+echo "== sim smoke (sanitized 16-worker simulated CPU driver) =="
+if ! JAX_PLATFORMS=cpu python - <<'EOF'
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import config_from_args
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+
+cfg = config_from_args([
+    "--device", "cpu", "--sanitize", "--model", "mlp",
+    "--dataset", "mnist", "--sim_workers", "16", "--topology", "ring",
+    "--epochs_global", "2", "--epochs_local", "1", "--batch_size", "16",
+    "--limit_train_samples", "256", "--limit_eval_samples", "64",
+    "--compute_dtype", "float32", "--no_augment",
+    "--aggregation_by", "weights", "--seed", "7",
+    "--compile_cache_dir", ""])
+res = train_global(cfg, progress=False)
+san = res["sanitize"]
+assert san == {"enabled": True, "transfer_guard_violations": 0,
+               "retrace_count": 0, "recompile_count": 0,
+               "donation_failures": 0}, san
+s = res["sim"]
+assert s["workers"] == 16 and s["rounds"] == 2, s
+assert s["per_worker_sync_bytes"] > 0
+assert res["sync_engine"]["mode"] == "sim"
+assert len(res["all_workers_losses"]) == 16
+print("sim smoke: sanitizer all-zero on the 16-worker vmap'd driver,",
+      s["per_worker_sync_bytes"], "wire bytes/worker")
+EOF
+then
+  echo "sim CLI smoke FAILED"; exit 1
+fi
+echo "sim CLI smoke OK"
 
 # Serving smoke (ISSUE 7): train 2 rounds of gpt_tiny with per-round
 # checkpoints, then `main.py serve` decodes a fixed prompt GREEDILY off
