@@ -1513,6 +1513,156 @@ def measure_compile() -> dict:
     }
 
 
+def measure_memory() -> dict:
+    """Memory-tier A/B (ISSUE 15): compiled ``temp_size_in_bytes`` across
+    the remat-policy ladder on a scanned GPT at L=8, plus the sim-lab
+    N-scaling memory curve.
+
+    Two asserted facts, measured not narrated:
+
+    1. **policy ordering** — XLA's temp allocation (scratch + the saved
+       autodiff residuals) is MONOTONE down the ladder ``none >=
+       dots_saveable >= save_names:attn_out >= everything`` (each policy
+       saves a subset of the previous one's residuals), strict at the
+       ends, while the fp32 training trajectory stays BITWISE-identical
+       on every arm (remat moves residency, never math) — including the
+       ``offload_names`` arm, which demotes to the same-set
+       ``save_names`` on this host-memory-less CPU backend and must land
+       the identical temp bytes;
+    2. **sim N-curve** — the vmap'd simulator's per-worker resident
+       state is CONSTANT in N while the one-chip stacked total is
+       exactly N x per-worker (``results["memory"]``'s analytic model
+       against the real stacked-state leaf bytes) — the quantity whose
+       real-chip HBM wall is the filed TPU follow-on.
+    """
+    import functools as ft
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu.models import get_model
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu import train as train_lib
+
+    VOCAB, B, L_SEQ, DEPTH = 211, 8, 32, 8
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, VOCAB, (B, L_SEQ)), jnp.int32)
+    y = jnp.asarray(rng.integers(0, VOCAB, (B, L_SEQ)), jnp.int32)
+    tx = optax.adam(1e-3)
+
+    def make_step(policy):
+        model = get_model("gpt_tiny", num_classes=VOCAB, num_layers=DEPTH,
+                          max_len=L_SEQ, scan_layers=True,
+                          remat_policy=None if policy == "none"
+                          else policy)
+
+        def loss_fn(p):
+            out = model.apply({"params": p}, x, train=True)
+            return train_lib.softmax_cross_entropy(out, y).mean()
+
+        @ft.partial(jax.jit, donate_argnums=0)
+        def step(state):
+            params, opt_state = state
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, new_opt = tx.update(grads, opt_state, params)
+            return (optax.apply_updates(params, updates), new_opt), loss
+        return model, step
+
+    # one shared init: every policy arm starts from the identical state
+    model0, _ = make_step("none")
+    params0 = jax.jit(lambda k: model0.init(k, x, train=False))(
+        jax.random.key(0))["params"]
+    opt0 = jax.jit(tx.init)(params0)
+
+    POLICIES = ("none", "dots_saveable", "save_names:attn_out",
+                "offload_names:attn_out", "everything")
+    arms: dict[str, dict] = {}
+    finals: dict[str, list] = {}
+    for policy in POLICIES:
+        _, step = make_step(policy)
+        state = (jax.tree_util.tree_map(jnp.copy, params0),
+                 jax.tree_util.tree_map(jnp.copy, opt0))
+        compiled = step.lower(state).compile()
+        ma = compiled.memory_analysis()
+        losses = []
+        for _ in range(3):
+            state, loss = compiled(state)
+            losses.append(np.asarray(loss))
+        jax.block_until_ready(state)
+        arms[policy] = {
+            "temp_mb": round(ma.temp_size_in_bytes / 2**20, 4),
+            "temp_bytes": int(ma.temp_size_in_bytes),
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "output_bytes": int(ma.output_size_in_bytes),
+            "losses": [float(v) for v in losses],
+        }
+        finals[policy] = (jax.tree_util.tree_leaves(
+            jax.device_get(state[0])), losses)
+
+    t = {p: arms[p]["temp_bytes"] for p in POLICIES}
+    monotone = (t["none"] >= t["dots_saveable"]
+                >= t["save_names:attn_out"] >= t["everything"]
+                and t["none"] > t["everything"])
+    base_leaves, base_losses = finals["none"]
+    bitwise = all(
+        all(np.array_equal(a, b) for a, b in zip(base_leaves, leaves))
+        and all(np.array_equal(a, b) for a, b in zip(base_losses, losses))
+        for leaves, losses in finals.values())
+
+    # --- sim-lab N-scaling memory curve --------------------------------
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+
+    def sim_row(n):
+        res = train_global(Config(
+            model="mlp", dataset="mnist", sim_workers=n,
+            epochs_global=2, epochs_local=1, batch_size=16,
+            limit_train_samples=16 * n * 2, limit_eval_samples=64,
+            compute_dtype="float32", augment=False,
+            aggregation_by="weights", seed=0), progress=False)
+        mem = res["memory"]
+        # the analytic stacked total vs the ACTUAL stacked device bytes
+        state = res["state"]
+        actual = sum(
+            l.nbytes for l in jax.tree_util.tree_leaves(state)
+            if hasattr(l, "nbytes"))
+        return {
+            "workers": n,
+            "per_worker_mb": round(
+                mem["per_worker_resident_bytes"] / 2**20, 4),
+            "per_worker_bytes": mem["per_worker_resident_bytes"],
+            "stacked_total_mb": round(mem["state_bytes_total"] / 2**20, 4),
+            "stacked_total_bytes": mem["state_bytes_total"],
+            "actual_state_bytes": int(actual),
+            "round_temp_bytes": sum(
+                r["temp_bytes"] for rs in mem["programs"].values()
+                for r in rs),
+        }
+
+    sim_rows = {f"n{n}": sim_row(n) for n in (8, 32)}
+    r8, r32 = sim_rows["n8"], sim_rows["n32"]
+    sim_linear = (
+        r8["per_worker_bytes"] == r32["per_worker_bytes"]
+        and r8["stacked_total_bytes"] == 8 * r8["per_worker_bytes"]
+        and r32["stacked_total_bytes"] == 32 * r32["per_worker_bytes"]
+        and r8["actual_state_bytes"] == r8["stacked_total_bytes"]
+        and r32["actual_state_bytes"] == r32["stacked_total_bytes"])
+
+    return {
+        "model": f"gpt_tiny L={DEPTH} scanned, B={B}, L_seq={L_SEQ}",
+        "policies": arms,
+        "temp_monotone_none_dots_named_everything": bool(monotone),
+        "bitwise_all_policies": bool(bitwise),
+        "offload_demotes_to_save_names": bool(
+            t["offload_names:attn_out"] == t["save_names:attn_out"]),
+        "temp_none_vs_everything":
+            round(t["none"] / max(t["everything"], 1), 2),
+        "sim_scaling": sim_rows,
+        "sim_per_worker_constant_total_linear": bool(sim_linear),
+    }
+
+
 def measure_round_gap() -> dict:
     """Host time between device rounds: serial vs overlapped pipeline.
 
@@ -1689,6 +1839,7 @@ SHORT = {
     "gossip_collectives": "gossip",
     "hier_sync": "hier",
     "compile_engine": "compile",
+    "memory_tier": "memory",
     "ckpt_engine": "ckpt",
     "serve_engine": "serve",
     "elastic_membership": "elastic",
@@ -1726,6 +1877,8 @@ def _run_entry(key: str, entry_budget: float | None = None) -> dict:
         return measure_hier()
     if key == "compile_engine":
         return measure_compile()
+    if key == "memory_tier":
+        return measure_memory()
     if key == "ckpt_engine":
         return measure_ckpt()
     if key == "serve_engine":
@@ -1842,6 +1995,17 @@ def _emit_headline(details: dict, extra: dict) -> None:
                      "scn": e.get("compile_scanned_L8_s"),
                      "same": 1 if e.get("loss_bitwise_scan_vs_unrolled")
                      else 0}
+        elif key == "memory_tier":
+            pol = e.get("policies") or {}
+            sim32 = (e.get("sim_scaling") or {}).get("n32") or {}
+            d[sk] = {"none": (pol.get("none") or {}).get("temp_mb"),
+                     "evr": (pol.get("everything") or {}).get("temp_mb"),
+                     "x": e.get("temp_none_vs_everything"),
+                     "n32": sim32.get("stacked_total_mb"),
+                     "mono": 1 if e.get(
+                         "temp_monotone_none_dots_named_everything")
+                     else 0,
+                     "same": 1 if e.get("bitwise_all_policies") else 0}
         elif key == "ckpt_engine":
             d[sk] = {"blk": e.get("blocking_ms"),
                      "sh": e.get("sharded_blocking_ms"),
@@ -1977,7 +2141,7 @@ def main() -> None:
         # sacrificial ViT tail
         jobs[at:at] = ([("round_gap", 150), ("sync_collectives", 120),
                         ("gossip_collectives", 120), ("hier_sync", 120),
-                        ("compile_engine", 150),
+                        ("compile_engine", 150), ("memory_tier", 150),
                         ("ckpt_engine", 120), ("serve_engine", 120),
                         ("elastic_membership", 150),
                         ("crash_recovery", 180),

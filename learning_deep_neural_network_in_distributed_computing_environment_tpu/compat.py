@@ -141,17 +141,144 @@ else:
 # default selective-remat recipe.  Returning None means "no policy kwarg"
 # (jax.checkpoint's default, which is full remat), so a runtime lacking a
 # named policy degrades to remat-everything instead of crashing.
+#
+# ISSUE 15 adds the NAMED-ACTIVATION tier: ``save_names:<a,b>`` keeps
+# exactly the ``checkpoint_name``-annotated activations in the set on
+# device (``save_only_these_names``), and ``offload_names:<a,b>``
+# additionally moves them to host memory between forward and backward
+# (``save_and_offload_only_these_names`` -> ``pinned_host`` — the
+# host-staging direction PR 5's snapshot pool proved out).  Both are
+# pure residency policies: the math is the unannotated math, so every
+# policy's fp32 trajectory is BITWISE the baseline's
+# (tests/test_remat_memory.py).
 REMAT_POLICIES = ("none", "dots_saveable", "everything")
+NAMED_REMAT_KINDS = ("save_names", "offload_names")
+
+
+try:
+    from jax.ad_checkpoint import checkpoint_name
+except ImportError:  # pragma: no cover — very old runtimes
+    def checkpoint_name(x, name):  # noqa: ARG001 — annotation becomes inert
+        """Identity on runtimes without the name primitive: the named
+        policies then degrade to save-nothing (the names never appear in
+        the jaxpr), which is safe-by-construction — remat never changes
+        math, only residency."""
+        return x
+
+
+if LEGACY_SHARD_MAP:
+    # Legacy shard_map's check_rep machinery has no replication rule for
+    # the ``name`` primitive (it predates widespread checkpoint_name
+    # use), so an annotated model would fail to trace under
+    # check_rep=True with "No replication rule for name".  ``name`` is a
+    # pure identity — replication passes straight through — which is
+    # exactly what the STANDARD check/rewrite rules model (every
+    # elementwise primitive registers them); register once at import.
+    try:
+        from jax._src.ad_checkpoint import name_p as _name_p
+        from jax.experimental import shard_map as _legacy_sm_module
+        _legacy_sm_module.register_standard_check(_name_p)
+        _legacy_sm_module.register_standard_rewrite(_name_p)
+    except Exception:  # pragma: no cover — internals moved; annotations
+        pass           # still trace under check_rep=False paths
+
+
+def split_remat_policy(policy: str) -> tuple[str, tuple[str, ...]]:
+    """``--remat_policy`` -> ``(kind, names)``: the three base spellings
+    parse as ``(spelling, ())``; the named tiers as ``("save_names" |
+    "offload_names", (name, ...))`` with duplicates collapsed.  Pure
+    syntax — vocabulary validation against the model family lives in
+    ``Config.parse_remat_policy`` (eager) so a typo'd name fails at
+    argparse time with the family's emitted vocabulary in the message."""
+    if ":" not in policy:
+        if policy not in REMAT_POLICIES:
+            raise ValueError(
+                f"remat policy must be one of {REMAT_POLICIES} or "
+                f"'save_names:<a,b>' / 'offload_names:<a,b>', got "
+                f"{policy!r}")
+        return policy, ()
+    kind, _, names_csv = policy.partition(":")
+    if kind not in NAMED_REMAT_KINDS:
+        raise ValueError(
+            f"named remat policy must start with one of "
+            f"{NAMED_REMAT_KINDS}, got {policy!r}")
+    names = tuple(dict.fromkeys(
+        n.strip() for n in names_csv.split(",") if n.strip()))
+    if not names:
+        raise ValueError(
+            f"--remat_policy {kind}: needs at least one activation name "
+            f"(e.g. {kind}:attn_out), got {policy!r}")
+    return kind, names
+
+
+def host_offload_supported() -> bool:
+    """True when this runtime can actually place offloaded-remat
+    residuals in host memory: the policy constructor exists AND the
+    backend exposes a distinct ``pinned_host`` memory space.  This
+    jaxlib-0.4.37 XLA:CPU exposes only ``unpinned_host`` (device memory
+    IS host memory), so offload demotes — see ``checkpoint_policy``."""
+    policies = getattr(jax, "checkpoint_policies", None)
+    if getattr(policies, "save_and_offload_only_these_names", None) is None:
+        return False
+    try:
+        kinds = {getattr(m, "kind", "")
+                 for m in jax.devices()[0].addressable_memories()}
+    except Exception:  # noqa: BLE001 — legacy runtimes lack the surface
+        return False
+    return "pinned_host" in kinds
+
+
+_OFFLOAD_DEMOTIONS_LOGGED: set[tuple[str, ...]] = set()
 
 
 def checkpoint_policy(name):
     """Resolve a named ``--remat_policy`` to a ``jax.checkpoint`` policy
-    callable (or None = jax's default full remat).  ``name`` must be one
-    of ``REMAT_POLICIES`` minus "none" — callers gate the "none" (no
-    remat at all) case themselves."""
+    callable (or None = jax's default full remat).  ``name`` is one of
+    ``REMAT_POLICIES`` minus "none" — callers gate the "none" (no remat
+    at all) case themselves — or a named-activation spelling
+    ``save_names:<a,b>`` / ``offload_names:<a,b>`` (ISSUE 15).
+
+    ``offload_names`` demotion: on a runtime/backend without a
+    ``pinned_host`` memory space (this jaxlib 0.4.37 CPU — device memory
+    IS unpinned host memory, there is nowhere distinct to offload TO)
+    the offload set demotes to the SAME-set ``save_names`` with a logged
+    reason.  Bitwise-safe by the remat contract: both policies save the
+    identical values, only their residency differs, and residency never
+    changes math."""
+    if ":" in name:
+        kind, names = split_remat_policy(name)
+        policies = getattr(jax, "checkpoint_policies", None)
+        save_only = getattr(policies, "save_only_these_names", None)
+        if save_only is None:  # pragma: no cover — very old runtimes
+            # no named-policy surface at all: degrade to full remat
+            # (jax.checkpoint's default), the same fallback the base
+            # spellings take — never crash over an optimization knob
+            return None
+        if kind == "offload_names":
+            if host_offload_supported():
+                return policies.save_and_offload_only_these_names(
+                    names_which_can_be_saved=[],
+                    names_which_can_be_offloaded=list(names),
+                    offload_src="device", offload_dst="pinned_host")
+            if names not in _OFFLOAD_DEMOTIONS_LOGGED:
+                _OFFLOAD_DEMOTIONS_LOGGED.add(names)
+                import logging
+                logging.getLogger(__name__).info(
+                    "remat policy offload_names:%s demoted to "
+                    "save_names:%s — this backend (%s) has no "
+                    "'pinned_host' memory space to offload to (XLA:CPU "
+                    "device memory IS host memory), so the same-set "
+                    "device-saved policy is the residency-equivalent; "
+                    "bitwise-identical math either way",
+                    ",".join(names), ",".join(names),
+                    jax.default_backend())
+            return save_only(*names)
+        return save_only(*names)
     if name not in REMAT_POLICIES or name == "none":
         raise ValueError(
-            f"remat policy must be one of {REMAT_POLICIES[1:]}, got {name!r}")
+            f"remat policy must be one of {REMAT_POLICIES[1:]} or a "
+            f"named-activation spelling ('save_names:<a,b>' / "
+            f"'offload_names:<a,b>'), got {name!r}")
     policies = getattr(jax, "checkpoint_policies", None)
     if name == "dots_saveable":
         return getattr(policies, "dots_saveable", None)

@@ -121,7 +121,21 @@ class Config:
     # rematerializes the whole block from its boundary activations (the
     # GPipe-paper recipe, max memory saving at ~1/3 extra forward
     # compute).  Applies to the scanned stack (layer_scan on / PP).
+    # ISSUE 15 named-activation tiers: "save_names:<a,b>" keeps exactly
+    # the checkpoint_name-annotated activations in the set on device
+    # (jax save_only_these_names), "offload_names:<a,b>" additionally
+    # offloads them to pinned host memory between forward and backward
+    # (save_and_offload_only_these_names; demoted to the same-set
+    # save_names with a logged reason on backends without a
+    # pinned_host memory space — this jaxlib 0.4.37 CPU).  Names are
+    # validated EAGERLY against the model family's emitted vocabulary
+    # (models.remat_name_vocab: attn_out / mlp_out / block_out /
+    # moe_dispatch) — a typo'd name would otherwise silently degrade
+    # the policy to save-nothing.  All policies are bitwise-identical
+    # in fp32 (remat moves residency, never math).
     remat_policy: str = "none"       # none | dots_saveable | everything
+    #                                  | save_names:<set>
+    #                                  | offload_names:<set>
     # grad_accum: split each train step's batch into K microbatches and
     # scan them with a donated fp32 gradient carry — per-device activation
     # memory is bounded by B/K while the effective batch, the optimizer
@@ -370,8 +384,7 @@ class Config:
         _choices("proportionality", self.proportionality, ("inverse", "direct", "uniform"))
         _choices("attention_impl", self.attention_impl, ("dense", "flash"))
         _choices("layer_scan", self.layer_scan, ("auto", "on", "off"))
-        _choices("remat_policy", self.remat_policy,
-                 ("none", "dots_saveable", "everything"))
+        self.parse_remat_policy()   # validates spelling + names eagerly
         _choices("sync_mode", self.sync_mode, ("auto", "dense", "sharded"))
         _choices("sync_dtype", self.sync_dtype,
                  ("float32", "bfloat16", "int8"))
@@ -838,6 +851,40 @@ class Config:
             return "replicated"
         return "resident"
 
+    def parse_remat_policy(self) -> tuple[str, tuple[str, ...]]:
+        """``--remat_policy`` as ``(kind, names)`` — eagerly validated
+        (ISSUE 15): the base spellings pass through; the named tiers
+        (``save_names:<a,b>`` / ``offload_names:<a,b>``) additionally
+        check every name against the model FAMILY's emitted
+        ``checkpoint_name`` vocabulary (``models.remat_name_vocab``), so
+        a typo'd activation name fails at argparse time with the real
+        vocabulary in the message instead of silently degrading the
+        policy to save-nothing.  The "named policy without a scanned
+        stack" case keeps the existing driver rejection (the resolution
+        needs the mesh's pipe axis, which config cannot see)."""
+        from .compat import split_remat_policy
+        kind, names = split_remat_policy(self.remat_policy)
+        if not names:
+            return kind, names
+        from .models import remat_name_vocab
+        vocab = remat_name_vocab(self.model, self.num_experts)
+        if not vocab:
+            raise ValueError(
+                f"--remat_policy {kind}:... selects checkpoint_name-"
+                f"annotated activations of the scanned transformer "
+                f"block; --model {self.model} has no scanned block path "
+                "(bert_*/gpt_*/llama_*/vit_* do)")
+        unknown = [n for n in names if n not in vocab]
+        if unknown:
+            moe = (f" (num_experts={self.num_experts})"
+                   if self.num_experts else "")
+            raise ValueError(
+                f"--remat_policy {kind}: unknown activation name(s) "
+                f"{unknown} — the {self.model} family{moe} emits exactly "
+                f"{sorted(vocab)} (a name outside the vocabulary would "
+                "silently degrade the policy to save-nothing)")
+        return kind, names
+
     def parse_chaos_kinds(self) -> tuple[str, ...]:
         """``--chaos_kinds`` as a validated kind tuple (ISSUE 12
         satellite): the kinds a ``--chaos random`` schedule may draw.
@@ -1086,11 +1133,17 @@ def build_argparser() -> argparse.ArgumentParser:
                         "lax.scan (compile once per block, not per layer); "
                         "auto = on for bert_*/gpt_*/llama_*/vit_*")
     p.add_argument("--remat_policy", type=str, default=d.remat_policy,
-                   choices=["none", "dots_saveable", "everything"],
                    help="jax.checkpoint policy for the scanned layer "
-                        "stack: dots_saveable saves matmul outputs, "
-                        "everything rematerializes whole blocks "
-                        "(GPipe-paper memory recipe)")
+                        "stack: none | dots_saveable (save matmul "
+                        "outputs) | everything (rematerialize whole "
+                        "blocks, the GPipe-paper memory recipe) | "
+                        "save_names:<a,b> (keep exactly the named "
+                        "activations on device; vocabulary attn_out/"
+                        "mlp_out/block_out/moe_dispatch) | "
+                        "offload_names:<a,b> (additionally offload the "
+                        "set to pinned host memory; demoted to the "
+                        "same-set save_names on backends without a "
+                        "host memory space)")
     p.add_argument("--grad_accum", type=int, default=d.grad_accum,
                    help="microbatch gradient accumulation factor: scan K "
                         "microbatches per step with a donated fp32 grad "

@@ -1814,6 +1814,32 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     results["sync_engine"]["sync_bytes_ici"] = ici_b
     results["sync_engine"]["sync_bytes_dcn"] = dcn_b
 
+    # compiled-memory observability (ISSUE 15): recorded like
+    # sync_engine / sanitize — every run artifact carries XLA's
+    # memory_analysis of every cached executable this run compiled
+    # (round / standalone sync / resident enter-gather / streamed chunk
+    # programs / the sim vmap program) plus the analytic resident-state
+    # model (per-worker bytes, the transient gathered peak, and the
+    # stacked/fleet total — on a simulated run that total is ONE chip's
+    # residency, the ISSUE 14 N-ceiling quantity).  Zero-round runs
+    # emit the row with an empty program map — the schema is
+    # unconditional.
+    results["memory"] = probe_lib.memory_report(
+        engine.memory_programs(),
+        state_bytes=engine.state_resident_bytes(state),
+        n_workers=n, sim=sim_on)
+    log.info(
+        "compiled memory: %d program(s), %.2f MB temp total; per-worker "
+        "resident state %.2f MB (+%.2f MB transient gather peak), "
+        "%s total %.2f MB",
+        len(results["memory"]["programs"]),
+        results["memory"]["temp_bytes_total"] / 2**20,
+        results["memory"]["per_worker_resident_bytes"] / 2**20,
+        results["memory"]["per_worker_state_bytes"].get(
+            "params_gathered_peak", 0) / 2**20,
+        "one-chip stacked" if sim_on else "fleet",
+        results["memory"]["state_bytes_total"] / 2**20)
+
     # sanitizer provenance (ISSUE 6): recorded like sync_engine — every
     # run artifact states whether it ran sanitized and what the harness
     # observed (all zeros on a clean run; enabled=False when off)

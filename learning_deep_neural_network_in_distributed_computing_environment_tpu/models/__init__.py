@@ -108,6 +108,36 @@ def is_token_model(name: str) -> bool:
     return name.lower().startswith(("bert", "gpt", "llama"))
 
 
+# The named-activation vocabulary of the shared scanned-block path
+# (ISSUE 15).  Every transformer family's block annotates EXACTLY these
+# ``checkpoint_name`` labels — the stable contract the ``--remat_policy
+# save_names:<set>`` / ``offload_names:<set>`` tiers select from, the
+# eager config validation checks against, and graftlint's R6 rule
+# discovers (a typo'd label silently degrades a named policy to
+# save-NOTHING, which is why the vocabulary is closed):
+#
+# - ``attn_out``  — the attention sublayer's output projection
+#   ([B, L, H] per block; the pjit/TPUv4 report's canonical save point);
+# - ``mlp_out``   — the FFN / MoE sublayer output ([B, L, H]);
+# - ``block_out`` — the block's residual-stream output (the layer
+#   boundary — saving only these IS the GPipe-paper recipe, spelled as
+#   a named set);
+# - ``moe_dispatch`` — the MoE dispatch einsum's expert-batched tokens
+#   ([E, C, H]; emitted only when the family runs with num_experts > 0).
+REMAT_NAMES = ("attn_out", "mlp_out", "block_out", "moe_dispatch")
+
+
+def remat_name_vocab(name: str, num_experts: int = 0) -> tuple[str, ...]:
+    """The ``checkpoint_name`` labels the ``name`` family's blocks emit
+    — what a named remat policy may select from.  CNN/MLP families emit
+    none (they have no scanned block path); ``moe_dispatch`` exists only
+    when the run actually builds MoE FFNs."""
+    if not is_attention_model(name):
+        return ()
+    base = ("attn_out", "mlp_out", "block_out")
+    return base + ("moe_dispatch",) if num_experts > 0 else base
+
+
 MODEL_INPUT_SPECS = {
     # name -> (example input shape without batch, num_classes or vocab)
     "enhanced_cnn": ((32, 32, 3), 10),

@@ -27,6 +27,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from ..compat import checkpoint_name
 from ..parallel.tp import copy_to_tp_region, reduce_from_tp_region
 
 _init = nn.initializers.normal(stddev=0.02)
@@ -126,11 +127,17 @@ class EncoderLayer(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask=None, *, train: bool = False, aux_scale=1.0):
-        # post-LN (original BERT): sublayer -> residual -> LayerNorm
-        a = SelfAttention(self.num_heads, dtype=self.dtype,
+        # post-LN (original BERT): sublayer -> residual -> LayerNorm.
+        # checkpoint_name labels (ISSUE 15: attn_out / mlp_out /
+        # block_out, models.REMAT_NAMES) mark the activations a
+        # --remat_policy save_names:/offload_names: set may pin on
+        # device / offload to host — inert identities otherwise
+        a = checkpoint_name(
+            SelfAttention(self.num_heads, dtype=self.dtype,
                           attention_impl=self.attention_impl,
                           axis_name=self.axis_name, tp_size=self.tp_size,
-                          model_axis=self.model_axis, name="attn")(x, mask)
+                          model_axis=self.model_axis, name="attn")(x, mask),
+            "attn_out")
         # LN output follows the compute dtype (flax does the mean/var math
         # in f32 internally); an f32 LN output would round-trip every
         # activation through HBM at twice the width
@@ -157,8 +164,10 @@ class EncoderLayer(nn.Module):
             f = reduce_from_tp_region(f, self.model_axis)
             f = f + self.param("ffn_bias", nn.initializers.zeros,
                                (x.shape[-1],)).astype(f.dtype)
-        return nn.LayerNorm(epsilon=1e-12, dtype=self.dtype,
-                            name="ln_ffn")(x + f)
+        f = checkpoint_name(f, "mlp_out")
+        return checkpoint_name(
+            nn.LayerNorm(epsilon=1e-12, dtype=self.dtype,
+                         name="ln_ffn")(x + f), "block_out")
 
 
 class _ScanLayer(nn.Module):
@@ -230,7 +239,12 @@ def apply_scanned_stack(scan_layer_cls, x, *, num_layers: int, pp_size: int,
         # boundary activations (the GPipe paper's own memory recipe,
         # ~1/3 extra forward compute); "dots_saveable" keeps matmul
         # outputs and recomputes only the cheap elementwise chains
-        # between them (the pjit/TPUv4 selective-remat default)
+        # between them (the pjit/TPUv4 selective-remat default);
+        # "save_names:<set>" / "offload_names:<set>" (ISSUE 15) keep
+        # exactly the checkpoint_name-annotated activations in the set
+        # on device / offloaded to pinned host memory (compat.py
+        # demotes offload to same-set save on backends without a host
+        # memory space)
         from ..compat import checkpoint_policy
         policy = checkpoint_policy(remat_policy)
         remat_kw = {} if policy is None else {"policy": policy}

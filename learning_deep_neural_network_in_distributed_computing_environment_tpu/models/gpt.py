@@ -27,6 +27,7 @@ from typing import Any, Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
+from ..compat import checkpoint_name
 from ..parallel.tp import copy_to_tp_region, reduce_from_tp_region
 from .bert import SelfAttention
 
@@ -54,11 +55,14 @@ class GPTBlock(nn.Module):
     @nn.compact
     def __call__(self, x, *, train: bool = False, aux_scale=1.0):
         h = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln1")(x)
-        a = SelfAttention(self.num_heads, dtype=self.dtype,
+        # named activations (ISSUE 15, models.REMAT_NAMES): inert
+        # identity labels a save_names:/offload_names: policy selects
+        a = checkpoint_name(
+            SelfAttention(self.num_heads, dtype=self.dtype,
                           attention_impl=self.attention_impl,
                           axis_name=self.axis_name, tp_size=self.tp_size,
                           model_axis=self.model_axis, causal=True,
-                          name="attn")(h)
+                          name="attn")(h), "attn_out")
         x = x + a
         f = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln2")(x)
         if self.num_experts:
@@ -83,7 +87,8 @@ class GPTBlock(nn.Module):
             f = reduce_from_tp_region(f, self.model_axis)
             f = f + self.param("ffn_bias", nn.initializers.zeros,
                                (x.shape[-1],)).astype(f.dtype)
-        return x + f
+        f = checkpoint_name(f, "mlp_out")
+        return checkpoint_name(x + f, "block_out")
 
 
 class _ScanBlock(nn.Module):

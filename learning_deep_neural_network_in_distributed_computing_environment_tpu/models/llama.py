@@ -32,6 +32,7 @@ from typing import Any, Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
+from ..compat import checkpoint_name
 from ..ops.attention import rope  # noqa: F401  (re-export; tests use it)
 from ..parallel.tp import copy_to_tp_region, reduce_from_tp_region
 from .bert import SelfAttention
@@ -60,13 +61,16 @@ class LlamaBlock(nn.Module):
     def __call__(self, x, *, train: bool = False, aux_scale=1.0):
         norm = lambda name: nn.RMSNorm(epsilon=1e-5, dtype=self.dtype,
                                        name=name)
-        a = SelfAttention(self.num_heads, dtype=self.dtype,
+        # named activations (ISSUE 15, models.REMAT_NAMES): inert
+        # identity labels a save_names:/offload_names: policy selects
+        a = checkpoint_name(
+            SelfAttention(self.num_heads, dtype=self.dtype,
                           attention_impl=self.attention_impl,
                           axis_name=self.axis_name, tp_size=self.tp_size,
                           model_axis=self.model_axis, causal=True,
                           rope_theta=self.rope_theta, use_bias=False,
                           num_kv_heads=self.num_kv_heads,
-                          name="attn")(norm("rms1")(x))
+                          name="attn")(norm("rms1")(x)), "attn_out")
         x = x + a
         f = norm("rms2")(x)
         if self.num_experts:
@@ -93,7 +97,8 @@ class LlamaBlock(nn.Module):
                          dtype=self.dtype,
                          name="ffn_out")(nn.silu(gate) * up)
             f = reduce_from_tp_region(f, self.model_axis)
-        return x + f
+        f = checkpoint_name(f, "mlp_out")
+        return checkpoint_name(x + f, "block_out")
 
 
 class _ScanLlamaBlock(nn.Module):

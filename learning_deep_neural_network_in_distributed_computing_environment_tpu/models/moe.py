@@ -34,6 +34,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..compat import checkpoint_name
+
 _init = nn.initializers.normal(stddev=0.02)
 
 
@@ -112,7 +114,13 @@ class MoEFFN(nn.Module):
         b2 = self.param("b2", nn.initializers.zeros, (e_local, h))
 
         dl = dispatch_local.astype(self.dtype)
-        xe = jnp.einsum("nec,nh->ech", dl, toks.astype(self.dtype))
+        # named activation "moe_dispatch" (ISSUE 15): the expert-batched
+        # dispatched tokens [E, C, H] — the MoE-specific residual a
+        # save_names:/offload_names: policy may pin (recomputing it
+        # re-pays the dense one-hot dispatch einsum)
+        xe = checkpoint_name(
+            jnp.einsum("nec,nh->ech", dl, toks.astype(self.dtype)),
+            "moe_dispatch")
         h1 = nn.gelu(jnp.einsum("ech,ehf->ecf", xe, w1.astype(self.dtype))
                      + b1[:, None, :].astype(self.dtype), approximate=False)
         # row-parallel w2: per-shard partial sums over the local F slice;

@@ -24,11 +24,172 @@ TPU-native redesign:
 
 from __future__ import annotations
 
+import logging
 import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+# ----------------------------------------------------------------------
+# Compiled-memory observability (ISSUE 15)
+# ----------------------------------------------------------------------
+# CPU cannot see HBM walls, so memory must be a MEASURED, asserted
+# quantity on every compiled program: each engine wraps its cached jit
+# programs in a TrackedProgram, which compiles ahead-of-time on first
+# call (lower().compile() — the same one trace + one backend compile the
+# jit path would pay; verified against the compile-event counter) and
+# keeps the jax.stages.Compiled handle so ``memory_report`` can read
+# XLA's ``memory_analysis()`` (temp/argument/output/alias bytes) without
+# ever re-lowering.  Calls after the first dispatch straight on the
+# compiled executable — donation, shardings, and fp32 numerics are
+# bitwise those of the jit path (tests/test_remat_memory.py pins this).
+
+
+class TrackedProgram:
+    """A cached engine program with its compiled executable retained.
+
+    ``single-shape`` mode (default — the engines key their caches by
+    input shape already): the first call AOT-compiles and every later
+    call dispatches on that executable with zero per-call bookkeeping.
+    ``multi_shape=True`` (the serve prefill program, one jit specialized
+    per prompt bucket): executables are kept per input-shape key.
+
+    Robustness: a multi-process run, or any lower/compile failure, falls
+    back to the plain jit call path for the life of the program (the
+    memory row then reports ``available: False`` instead of killing the
+    run — observability must never take down training).
+    """
+
+    def __init__(self, name: str, fn, *, multi_shape: bool = False):
+        self.name = name
+        self._fn = fn
+        self._multi = bool(multi_shape)
+        self._fallback = jax.process_count() > 1
+        self.compiled = None           # single-shape executable
+        self._by_shape: dict = {}      # multi-shape: key -> executable
+
+    @staticmethod
+    def _shape_key(args):
+        return tuple(
+            (tuple(np.shape(l)), str(getattr(l, "dtype", type(l).__name__)))
+            for l in jax.tree_util.tree_leaves(args))
+
+    def _compile(self, args, kwargs):
+        return self._fn.lower(*args, **kwargs).compile()
+
+    def __call__(self, *args, **kwargs):
+        if self._fallback:
+            return self._fn(*args, **kwargs)
+        try:
+            if self._multi:
+                key = self._shape_key((args, kwargs))
+                comp = self._by_shape.get(key)
+                if comp is None:
+                    comp = self._by_shape[key] = self._compile(args, kwargs)
+            else:
+                comp = self.compiled
+                if comp is None:
+                    comp = self.compiled = self._compile(args, kwargs)
+        except Exception as e:  # noqa: BLE001 — observability never kills
+            log.warning(
+                "memory tracking: AOT compile of program %r unavailable "
+                "(%s) — falling back to the plain jit path (its memory "
+                "row will report available=False)", self.name, e)
+            self._fallback = True
+            return self._fn(*args, **kwargs)
+        return comp(*args, **kwargs)
+
+    def executables(self) -> list:
+        if self.compiled is not None:
+            return [self.compiled]
+        return list(self._by_shape.values())
+
+    def memory_rows(self) -> list[dict]:
+        """One ``memory_analysis()`` row per compiled executable (the
+        multi-shape prefill program has one per bucket)."""
+        return [r for r in (memory_analysis_row(c)
+                            for c in self.executables()) if r is not None]
+
+
+def memory_analysis_row(compiled) -> dict | None:
+    """XLA's compiled-memory stats for one executable, as plain ints:
+    ``temp_bytes`` (scratch + saved activations — the quantity the remat
+    policy moves), ``argument_bytes`` / ``output_bytes`` (I/O buffers),
+    ``alias_bytes`` (donated input bytes reused for outputs — subtracted
+    from the true footprint since aliased pairs share one buffer), and
+    ``generated_code_bytes``.  None when the backend cannot analyze
+    (some PJRT plugins raise Unimplemented)."""
+    try:
+        ma = compiled.memory_analysis()
+        return {
+            "temp_bytes": int(ma.temp_size_in_bytes),
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "output_bytes": int(ma.output_size_in_bytes),
+            "alias_bytes": int(ma.alias_size_in_bytes),
+            "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+        }
+    except Exception as e:  # noqa: BLE001 — backend-dependent surface
+        log.debug("memory_analysis unavailable: %s", e)
+        return None
+
+
+def memory_report(programs: dict, *, state_bytes: dict | None = None,
+                  n_workers: int = 1, sim: bool = False) -> dict:
+    """The uniform ``results["memory"]`` row (ISSUE 15) — emitted on
+    every run like ``sync_engine`` / ``sanitize``.
+
+    Two views of the same wall:
+
+    - **compiled**: per-program ``memory_analysis()`` of every cached
+      executable (``programs``: name -> TrackedProgram).  ``temp_bytes``
+      is where a remat policy shows up — saved activations are XLA temp
+      allocations, so ``none >= dots_saveable >= save_names:<set> >=
+      everything`` is an asserted ordering (bench ``--entry memory``),
+      not a narrative.  A program that fell back to the jit path (or a
+      backend without the analysis) contributes no row and flips
+      ``available`` off.
+    - **analytic resident model**: ``per_worker_state_bytes`` (the
+      ISSUE 9/11 accounting) extended with the stacked/fleet total
+      (``state_bytes_total`` = workers x per-worker — on a simulated run
+      that total is ONE chip's stacked residency, the ISSUE 14 N-ceiling
+      quantity) and the worker peak (resident + the transient
+      ``params_gathered_peak`` the round-entry gather materializes).
+    """
+    rows: dict[str, list[dict]] = {}
+    missing: list[str] = []
+    for name, tp in programs.items():
+        r = tp.memory_rows() if hasattr(tp, "memory_rows") else []
+        if r:
+            rows[name] = r
+        else:
+            missing.append(name)
+    temp_total = sum(r["temp_bytes"] for rs in rows.values() for r in rs)
+    report: dict = {
+        "available": bool(rows) and not missing,
+        "programs": rows,
+        "programs_unavailable": missing,
+        "temp_bytes_total": temp_total,
+        "workers": int(n_workers),
+        "simulated": bool(sim),
+    }
+    if state_bytes is not None:
+        peak = int(state_bytes.get("params_gathered_peak", 0))
+        resident = sum(int(v) for k, v in state_bytes.items()
+                       if k != "params_gathered_peak")
+        report["per_worker_state_bytes"] = dict(state_bytes)
+        report["per_worker_resident_bytes"] = resident
+        # worker peak = steady resident state + the transient padded
+        # gather buffers (zero on replicated layouts — no transient copy)
+        report["per_worker_peak_bytes"] = resident + peak
+        # fleet total on a real mesh; ONE-CHIP stacked total on a
+        # simulated run (N x per-worker by construction — the measured
+        # form of the sim-lab N-ceiling)
+        report["state_bytes_total"] = resident * int(n_workers)
+    return report
 
 
 def measure_step_time(model, variables, sample_batch: np.ndarray,

@@ -153,6 +153,13 @@ def run_serve(cfg, requests: Optional[list] = None, *,
         request_timeout=cfg.serve_request_timeout)
     telemetry = sched.run(requests)
     completions = telemetry.pop("completions")
+    # compiled-memory observability (ISSUE 15): the serve twin of the
+    # driver's results["memory"] — memory_analysis of the decode-step
+    # executable + every compiled prefill bucket (no analytic resident
+    # model: serve state is the params + the byte-exact page accounting
+    # the scheduler already reports)
+    from ..probe import memory_report
+    telemetry["memory"] = memory_report(engine.memory_programs())
     telemetry["retrace_count"] = 0
     telemetry["recompile_count"] = 0
     telemetry["sanitized"] = bool(sanitize and counter_ok)

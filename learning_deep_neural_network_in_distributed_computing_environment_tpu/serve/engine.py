@@ -351,11 +351,27 @@ class ServeEngine:
         self.params = jax.tree_util.tree_map(_stage, params)
         kc, vc = D.init_paged_cache(self.spec, max_pages, self.page_size)
         self.kcache, self.vcache = _stage(kc), _stage(vc)
-        self._decode = _build_decode_program(self.spec, self.seed)
-        # ONE jit'd prefill: jit specializes per bucket shape internally,
-        # so per-bucket wrapper objects would be redundant state
-        self._prefill = _build_prefill_program(self.spec, self.seed)
+        # compiled-memory observability (ISSUE 15): both serve programs
+        # ride probe.TrackedProgram (AOT compile on first call, the
+        # executable handle retained so memory_report reads
+        # memory_analysis() without re-lowering).  The decode step has
+        # ONE fixed shape (single-shape mode: zero per-call bookkeeping
+        # on the hot loop); the prefill program specializes per prompt
+        # bucket (multi_shape: one executable per bucket, keyed on the
+        # admission path — not hot)
+        from ..probe import TrackedProgram
+        self._decode = TrackedProgram(
+            "decode_step", _build_decode_program(self.spec, self.seed))
+        self._prefill = TrackedProgram(
+            "prefill", _build_prefill_program(self.spec, self.seed),
+            multi_shape=True)
         self.compiled_buckets: list[int] = []
+
+    def memory_programs(self) -> dict:
+        """Label -> TrackedProgram registry (the serve twin of
+        ``LocalSGDEngine.memory_programs``): the fixed-batch decode step
+        plus one prefill executable per compiled prompt bucket."""
+        return {"decode_step": self._decode, "prefill": self._prefill}
 
     # -- construction from a sharded checkpoint ------------------------
     @classmethod

@@ -359,7 +359,10 @@ class SimEngine(LocalSGDEngine):
         if key not in self._round_cache:
             log.info("compiling simulated round program for %d workers, "
                      "shapes %s", self.n_workers, key)
-            self._round_cache[key] = self._build_round(key)
+            # tracked like every engine program (ISSUE 15): the one
+            # vmap'd round executable's memory_analysis is what the
+            # sim-lab N-ceiling measurement reads on a real chip
+            self._track(key, self._build_round(key), "sim_round")
         extra = ()
         if self.scenario_on:
             active, dropped, noise_key = self._draw_scenario()

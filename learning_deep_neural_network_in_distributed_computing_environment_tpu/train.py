@@ -56,6 +56,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from . import comms
+from . import probe as probe_lib
 from .compat import (LEGACY_SHARD_MAP, axis_size, optimization_barrier,
                      pcast, shard_map, typeof)
 from .config import Config
@@ -515,6 +516,12 @@ class LocalSGDEngine:
         # outside so StepLR can drive it per local epoch.
         self.tx = optax.scale_by_adam(b1=0.9, b2=0.999, eps=1e-8)
         self._round_cache: dict[tuple, Callable] = {}
+        # compiled-memory observability (ISSUE 15): every cached engine
+        # program is wrapped in a probe.TrackedProgram (AOT lower +
+        # compile on first call, executable handle retained), keyed by a
+        # stable label — probe.memory_report walks this registry into
+        # the uniform results["memory"] row
+        self._programs: dict[str, probe_lib.TrackedProgram] = {}
         self._spec = (P((SLICE_AXIS, DATA_AXIS)) if self.slice_axis
                       else P(DATA_AXIS))
         # --- round-sync engine selection (ISSUE 2 / ISSUE 13) ----------
@@ -922,6 +929,32 @@ class LocalSGDEngine:
                                 "sync_ms_dcn": 0.0}
         self._sync_probe = None
 
+    def _track(self, key, fn, name: str):
+        """Install a freshly-built engine program into the round cache
+        wrapped for compiled-memory observability (ISSUE 15): the
+        TrackedProgram AOT-compiles on first call — the same one trace +
+        one backend compile the jit path would pay — and retains the
+        ``jax.stages.Compiled`` handle so ``memory_report`` reads
+        ``memory_analysis()`` without re-lowering.  ``key=None`` tracks
+        without caching (the standalone sync's inner program lives
+        inside its run closure)."""
+        label, i = name, 2
+        while label in self._programs:
+            label, i = f"{name}#{i}", i + 1
+        tp = probe_lib.TrackedProgram(label, fn)
+        self._programs[label] = tp
+        if key is not None:
+            self._round_cache[key] = tp
+        return tp
+
+    def memory_programs(self) -> dict:
+        """Label -> TrackedProgram registry of every cached engine
+        program compiled so far (round / sync / resident enter-gather /
+        streamed chunk programs / the sim vmap program) — the input of
+        ``probe.memory_report`` and the driver's ``results["memory"]``
+        row."""
+        return dict(self._programs)
+
     def state_resident_bytes(self, state: TrainState) -> dict:
         """Per-worker RESIDENT bytes of each ``TrainState`` component
         (ISSUE 9 satellite: the N-fold optimizer-state drop as a measured
@@ -973,7 +1006,15 @@ class LocalSGDEngine:
                 "round_opt": per_worker(state.round_opt),
                 # ISSUE 12: the buddy copy's per-worker cost — one extra
                 # shard-row set, i.e. ~1/N of each protected component
-                "buddy": per_worker(state.buddy)}
+                "buddy": per_worker(state.buddy),
+                # ISSUE 15: the remaining TrainState rows, so the
+                # component sum IS the state's exact device footprint
+                # (results["memory"] asserts analytic == actual leaf
+                # bytes; the sim lab's stacked total must account every
+                # byte or the N-ceiling model silently undercounts)
+                "batch_stats": per_worker(state.batch_stats),
+                "bookkeeping": (per_worker(state.lr_epoch)
+                                + per_worker(state.rng))}
 
     def _derive_buddy_host(self, state: TrainState):
         """Host-derive the buddy rows a state implies (ISSUE 12): a
@@ -1932,7 +1973,7 @@ class LocalSGDEngine:
         key = (tuple(x.shape[1:]), tuple(xv.shape[1:]))
         if key not in self._round_cache:
             log.info("compiling round program for shapes %s", key)
-            self._round_cache[key] = self._build_round(key)
+            self._track(key, self._build_round(key), "round")
         if self.nan_screen and poison is None:
             poison = self.stage_poison(np.zeros(self.n_workers, np.bool_))
         extra = ((poison,) if self.nan_screen and not self.split_sync
@@ -2006,7 +2047,6 @@ class LocalSGDEngine:
             if self.last_sync_stats is not None:
                 sync_ms = round((time.perf_counter() - t0) * 1e3, 3)
                 self.last_sync_stats["sync_ms"] = sync_ms
-                from . import probe as probe_lib
                 ici_ms, dcn_ms = probe_lib.attribute_sync_wall(
                     sync_ms, *self._sync_bytes_split)
                 self.last_sync_stats["sync_ms_ici"] = ici_ms
@@ -2237,9 +2277,11 @@ class LocalSGDEngine:
             out_specs["buddy"] = self._spec
         if screen:
             out_specs["ok"] = self._spec
-        prog = self._wrap_stacked(per_worker, in_specs,
-                                  out_specs=out_specs,
-                                  donate=tuple(donate))
+        prog = self._track(None,
+                           self._wrap_stacked(per_worker, in_specs,
+                                              out_specs=out_specs,
+                                              donate=tuple(donate)),
+                           "sync")
 
         def run(*args, poison=None):
             if screen:
@@ -2307,15 +2349,16 @@ class LocalSGDEngine:
             # sync at round end re-scatters it and the chunk programs'
             # donation frees the working copy)
             if "enter" not in self._round_cache:
-                self._round_cache["enter"] = comms.make_resident_gather(
+                self._track("enter", comms.make_resident_gather(
                     self.mesh, self.params_template,
-                    bucket_bytes=self.sync_bucket_bytes, donate=True)
+                    bucket_bytes=self.sync_bucket_bytes, donate=True),
+                    "resident_enter")
             params0 = self._round_cache["enter"](state.params_resident)
         if "zeros" not in self._round_cache:
-            self._round_cache["zeros"] = jax.jit(
+            self._track("zeros", jax.jit(
                 lambda p: jax.tree_util.tree_map(jnp.zeros_like, p),
                 out_shardings=jax.tree_util.tree_map(
-                    lambda x: x.sharding, params0))
+                    lambda x: x.sharding, params0)), "stream_zeros")
         zeros_like = self._round_cache["zeros"]
 
         inner = (params0, state.batch_stats, state.opt_state, state.rng,
@@ -2347,8 +2390,8 @@ class LocalSGDEngine:
                     key = ("ct", tuple(x.shape[1:]))
                     if key not in self._round_cache:
                         log.info("compiling chunk-train program for %s", key)
-                        self._round_cache[key] = self._build_chunk_train(
-                            tuple(x.shape[1:]))
+                        self._track(key, self._build_chunk_train(
+                            tuple(x.shape[1:])), "chunk_train")
                     inner, ys = self._round_cache[key](inner, lr, x, y, m)
                     t_ys.append(ys)
                 v_sums = []
@@ -2357,8 +2400,8 @@ class LocalSGDEngine:
                     key = ("ce", tuple(x.shape[1:]))
                     if key not in self._round_cache:
                         log.info("compiling chunk-eval program for %s", key)
-                        self._round_cache[key] = self._build_chunk_eval(
-                            tuple(x.shape[1:]))
+                        self._track(key, self._build_chunk_eval(
+                            tuple(x.shape[1:])), "chunk_eval")
                     v_sums.append(self._round_cache[key](
                         inner[0], inner[1], x, y, m))
             except BaseException:
@@ -2427,8 +2470,9 @@ class LocalSGDEngine:
         # it, and on TPU it is a needless blocking H2D in the round loop.
         # Inside jit the addend is a trace-time constant instead.
         if "bump_epoch" not in self._round_cache:
-            self._round_cache["bump_epoch"] = jax.jit(
-                lambda e: e + jnp.asarray(cfg.epochs_local, e.dtype))
+            self._track("bump_epoch", jax.jit(
+                lambda e: e + jnp.asarray(cfg.epochs_local, e.dtype)),
+                "bump_epoch")
         new_state = TrainState(
             params=params, params_resident=resident,
             batch_stats=batch_stats, opt_state=opt_state,

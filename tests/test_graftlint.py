@@ -498,6 +498,68 @@ fn = shard_map(lambda x: x, mesh=None, in_specs=None, out_specs=None)
 
 
 # --------------------------------------------------------------------
+# R6: checkpoint_name remat-label vocabulary (ISSUE 15)
+# --------------------------------------------------------------------
+class TestR6RematNames:
+    def test_typo_label_flagged(self):
+        # the hazard: a typo'd label never matches a --remat_policy
+        # save_names:/offload_names: set — silent save-nothing
+        src = """
+from pkg.compat import checkpoint_name
+def block(x):
+    return checkpoint_name(x, "atn_out")
+"""
+        assert rules_for(src) == ["R6"]
+
+    def test_vocabulary_labels_clean(self):
+        src = """
+from jax.ad_checkpoint import checkpoint_name
+def block(x):
+    a = checkpoint_name(x, "attn_out")
+    f = checkpoint_name(a, name="mlp_out")
+    return checkpoint_name(a + f, "block_out")
+"""
+        assert rules_for(src) == []
+
+    def test_dotted_spelling_and_kwarg_typo_flagged(self):
+        src = """
+import jax
+def block(x):
+    return jax.ad_checkpoint.checkpoint_name(x, name="block_output")
+"""
+        assert rules_for(src) == ["R6"]
+
+    def test_dynamic_label_skipped(self):
+        # same silence rule as R3's dynamic axis args: a computed label
+        # is someone else's contract
+        src = """
+from pkg.compat import checkpoint_name
+def block(x, label):
+    return checkpoint_name(x, label)
+"""
+        assert rules_for(src) == []
+
+    def test_remat_vocab_discovered_from_models_init(self):
+        # the vocabulary is DISCOVERED from models/__init__.py's
+        # REMAT_NAMES constant, like R3's mesh.py axis discovery
+        from tools.graftlint.core import discover_remat_vocab
+        vocab = discover_remat_vocab([PKG])
+        assert {"attn_out", "mlp_out", "block_out",
+                "moe_dispatch"} <= set(vocab)
+
+    def test_custom_vocab_overrides_default(self):
+        src = """
+from pkg.compat import checkpoint_name
+def block(x):
+    return checkpoint_name(x, "my_custom_site")
+"""
+        assert [r.rule for r in lint_source(src, "s.py")] == ["R6"]
+        assert [r.rule for r in lint_source(
+            src, "s.py",
+            remat_vocab=frozenset({"my_custom_site"}))] == []
+
+
+# --------------------------------------------------------------------
 # R5: dtype-promotion traps
 # --------------------------------------------------------------------
 class TestR5DtypeTraps:

@@ -26,7 +26,7 @@ DEFAULT_TARGET = os.path.join(
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="graftlint",
-        description="JAX-hazard static analysis (rules R1-R5; see "
+        description="JAX-hazard static analysis (rules R1-R6; see "
                     "docs/LINT.md)")
     p.add_argument("paths", nargs="*", default=None,
                    help="files/dirs to lint (default: the package)")

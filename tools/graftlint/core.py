@@ -24,13 +24,19 @@ import re
 import tokenize
 from dataclasses import dataclass, field
 
-from .rules import DEFAULT_AXIS_VOCAB, RawFinding, lint_source
+from .rules import (DEFAULT_AXIS_VOCAB, DEFAULT_REMAT_NAME_VOCAB,
+                    RawFinding, lint_source)
 
 _DISABLE_RE = re.compile(
     r"#\s*graftlint:\s*(disable(?:-file)?)\s*=\s*"
     r"((?:R\d+|all)(?:\s*,\s*(?:R\d+|all))*)", re.IGNORECASE)
 _AXIS_CONST_RE = re.compile(
     r'^([A-Z][A-Z0-9_]*_AXIS)\s*=\s*["\']([a-z0-9_]+)["\']', re.MULTILINE)
+# the models package's named-activation contract (ISSUE 15):
+# REMAT_NAMES = ("attn_out", ...) — R6's discovered vocabulary
+_REMAT_NAMES_RE = re.compile(
+    r"^REMAT_NAMES\s*=\s*\(([^)]*)\)", re.MULTILINE)
+_STR_LIT_RE = re.compile(r'["\']([a-z0-9_]+)["\']')
 
 
 @dataclass
@@ -123,6 +129,35 @@ def discover_axis_vocab(paths: list[str]) -> tuple[frozenset[str],
     return frozenset(vocab), constants
 
 
+def discover_remat_vocab(paths: list[str]) -> frozenset[str]:
+    """Remat-name vocabulary (R6, ISSUE 15) from any models package's
+    ``REMAT_NAMES = ("...", ...)`` constant under the lint paths —
+    the axis-vocabulary discovery applied to named activations.  Falls
+    back to the default vocabulary when none is found."""
+    names: set[str] = set()
+    for path in paths:
+        candidates = []
+        if os.path.isfile(path) and path.endswith(".py"):
+            candidates = [path]
+        elif os.path.isdir(path):
+            for root, _dirs, files in os.walk(path):
+                if (os.path.basename(root) == "models"
+                        and "__init__.py" in files):
+                    candidates.append(os.path.join(root, "__init__.py"))
+        for c in candidates:
+            try:
+                with open(c, encoding="utf-8") as f:
+                    src = f.read()
+            except OSError:
+                continue
+            m = _REMAT_NAMES_RE.search(src)
+            if m:
+                names.update(_STR_LIT_RE.findall(m.group(1)))
+    if not names:
+        return DEFAULT_REMAT_NAME_VOCAB
+    return frozenset(names)
+
+
 def _py_files(paths: list[str]) -> list[str]:
     out: list[str] = []
     for path in paths:
@@ -150,6 +185,7 @@ def lint_paths(paths: list[str], *, repo_root: str | None = None,
         axis_vocab, constants = discover_axis_vocab(paths)
     else:
         _, constants = discover_axis_vocab(paths)
+    remat_vocab = discover_remat_vocab(paths)
     findings: list[Finding] = []
     for fpath in _py_files(paths):
         try:
@@ -160,7 +196,8 @@ def lint_paths(paths: list[str], *, repo_root: str | None = None,
         rel = os.path.relpath(os.path.abspath(fpath), root)
         per_line, file_level = _suppressions(src)
         lines = src.splitlines()
-        for raw in lint_source(src, rel, axis_vocab, constants):
+        for raw in lint_source(src, rel, axis_vocab, constants,
+                               remat_vocab):
             if _suppressed(raw, per_line, file_level):
                 continue
             text = lines[raw.line - 1] if 0 < raw.line <= len(lines) else ""

@@ -37,6 +37,9 @@ RULES = {
           "engine entry point (jit of shard_map) without donate_argnums",
     "R5": "dtype-promotion trap: float64 constructor or dtype=float in "
           "traced code, accumulator carry inheriting input dtype",
+    "R6": "checkpoint_name label outside the discovered remat-name "
+          "vocabulary (a typo'd label silently degrades a named remat "
+          "policy to save-nothing)",
 }
 
 # Mesh axis vocabulary fallback when no mesh.py is found on the lint path.
@@ -44,6 +47,23 @@ RULES = {
 # name like the others; a discovered mesh.py overrides this set entirely.
 DEFAULT_AXIS_VOCAB = frozenset(
     {"data", "model", "pipe", "seq", "expert", "fsdp", "slice"})
+
+# Named-activation vocabulary fallback when no models/__init__.py
+# REMAT_NAMES constant is found on the lint path (ISSUE 15).  R6 is the
+# R3 construction applied to checkpoint_name labels: like a typo'd axis
+# name, a label outside the vocabulary doesn't error — it just never
+# matches a --remat_policy save_names:/offload_names: set, silently
+# degrading the policy to save-NOTHING for that activation.
+DEFAULT_REMAT_NAME_VOCAB = frozenset(
+    {"attn_out", "mlp_out", "block_out", "moe_dispatch"})
+
+# Call spellings whose string label R6 validates (the repo imports the
+# jax primitive under its own name; dotted jax spellings included so
+# direct uses lint too).
+_CHECKPOINT_NAME_CALLS = {
+    "checkpoint_name", "jax.ad_checkpoint.checkpoint_name",
+    "ad_checkpoint.checkpoint_name",
+}
 
 # Call targets (dotted-suffix spellings) that make their first function
 # argument a traced root.
@@ -357,11 +377,13 @@ def _shard_map_spec_axes(call: ast.Call, axis_vocab: frozenset[str]
 
 def lint_source(src: str, path: str = "<string>",
                 axis_vocab: frozenset[str] | None = None,
-                axis_constants: dict[str, str] | None = None
+                axis_constants: dict[str, str] | None = None,
+                remat_vocab: frozenset[str] | None = None
                 ) -> list[RawFinding]:
-    """All R1-R5 findings for one file's source (pre-suppression)."""
+    """All R1-R6 findings for one file's source (pre-suppression)."""
     vocab = axis_vocab or DEFAULT_AXIS_VOCAB
     consts = axis_constants or {}
+    rvocab = remat_vocab or DEFAULT_REMAT_NAME_VOCAB
     try:
         tree = ast.parse(src, filename=path)
     except SyntaxError as e:
@@ -636,6 +658,27 @@ def lint_source(src: str, path: str = "<string>",
                      "the enclosing function pays a fresh "
                      "retrace+compile; hoist/cache the jitted callable "
                      "(module level, __init__, or a program cache)")
+
+    # R6: checkpoint_name labels vs the remat-name vocabulary (ISSUE 15;
+    # the R3 construction applied to named-activation labels).  Only
+    # string LITERALS are checked — a dynamic label is someone else's
+    # contract (same silence rule as R3's dynamic axis args).
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not _suffix_in(_dotted(node.func), _CHECKPOINT_NAME_CALLS):
+            continue
+        label_arg = (node.args[1] if len(node.args) > 1
+                     else _call_kw(node, "name"))
+        label = _const_str(label_arg) if label_arg is not None else None
+        if label is not None and label not in rvocab:
+            emit("R6", label_arg,
+                 f"checkpoint_name label {label!r} is not in the "
+                 f"remat-name vocabulary {sorted(rvocab)} — a label "
+                 "outside the vocabulary never matches a --remat_policy "
+                 "save_names:/offload_names: set, silently degrading "
+                 "the policy to save-nothing for that activation (add "
+                 "it to models.REMAT_NAMES if it is a new name)")
 
     # R4: use-after-donate within one function
     for fn in [n for n in ast.walk(tree) if isinstance(n, _FUNCS)]:

@@ -322,6 +322,40 @@ EOF
     echo "sim smoke assertions FAILED (rc=$simrc)"
     exit "$simrc"
   fi
+
+  # Memory-tier bench smoke (ISSUE 15): the --entry memory A/B must
+  # prove the compiled-memory ladder on every sweep — temp bytes
+  # MONOTONE down none >= dots_saveable >= save_names:attn_out >=
+  # everything on a scanned L=8 family, every arm's fp32 trajectory
+  # BITWISE the baseline's (incl. the offload arm, demoted to same-set
+  # save on this host-memory-less CPU), and the sim lab's stacked
+  # residency exactly N x per-worker.
+  echo "== bench smoke: memory tier entry (CPU, gpt L=8 + sim curve) =="
+  MEM_JSON=$(JAX_PLATFORMS=cpu BENCH_BUDGET_S="${BENCH_BUDGET_S:-300}" \
+    python bench.py --entry memory) || { echo "memory smoke FAILED"; exit 1; }
+  echo "$MEM_JSON"
+  python - "$MEM_JSON" <<'EOF'
+import json, sys
+out = json.loads(sys.argv[1])
+if out.get("status") == "budget_backstop":
+    sys.exit(0)  # slow host: the backstop line is the accepted outcome
+assert out["temp_monotone_none_dots_named_everything"] is True, out
+assert out["bitwise_all_policies"] is True, out
+assert out["offload_demotes_to_save_names"] is True, out
+assert out["sim_per_worker_constant_total_linear"] is True, out
+assert out["temp_none_vs_everything"] > 1.0, out
+pol = out["policies"]
+print("memory smoke OK: temp MB none", pol["none"]["temp_mb"],
+      ">= dots", pol["dots_saveable"]["temp_mb"],
+      ">= named", pol["save_names:attn_out"]["temp_mb"],
+      ">= everything", pol["everything"]["temp_mb"],
+      "| bitwise all arms; sim stacked = N x per-worker")
+EOF
+  memrc=$?
+  if [ "$memrc" -ne 0 ]; then
+    echo "memory smoke assertions FAILED (rc=$memrc)"
+    exit "$memrc"
+  fi
 fi
 
 # Checkpoint kill-mid-write -> resume smoke (ISSUE 5 satellite): phase A
@@ -798,6 +832,55 @@ EOF
 rc=$?
 if [ "$rc" -ne 0 ]; then
   echo "param-residency smoke FAILED (rc=$rc)"
+  exit "$rc"
+fi
+
+# Memory-tier driver smoke (ISSUE 15): a sanitized 2-worker CPU run of
+# a SCANNED family under --remat_policy save_names:attn_out vs the
+# "none" twin — the named policy resolves through the real config/
+# driver/engine plumbing, the fp32 trajectory and final params are
+# BITWISE the baseline's (remat never changes math), zero post-warmup
+# retraces, and every run emits a populated results["memory"] row
+# (compiled temp/argument bytes per cached executable + the exact
+# resident-state accounting).
+echo "== memory-tier smoke (2-worker save_names vs none, sanitized) =="
+XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+  JAX_PLATFORMS=cpu python - <<'EOF'
+import numpy as np
+import jax
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+
+kw = dict(model="gpt_tiny", dataset="synthetic_lm", epochs_global=2,
+          epochs_local=1, batch_size=4, limit_train_samples=64,
+          limit_eval_samples=16, compute_dtype="float32", augment=False,
+          seed=7, num_workers=2, aggregation_by="weights", sanitize=True)
+runs = {}
+for pol in ("none", "save_names:attn_out"):
+    res = train_global(Config(remat_policy=pol, **kw), progress=False)
+    assert res["sanitize"]["retrace_count"] == 0, res["sanitize"]
+    m = res["memory"]
+    assert m["available"] is True, m
+    assert m["programs"]["round"][0]["temp_bytes"] > 0, m
+    assert m["state_bytes_total"] == 2 * m["per_worker_resident_bytes"]
+    runs[pol] = res
+base, named = runs["none"], runs["save_names:attn_out"]
+assert base["global_train_losses"] == named["global_train_losses"]
+a = jax.tree_util.tree_leaves(base["variables"]["params"])
+b = jax.tree_util.tree_leaves(named["variables"]["params"])
+assert a and len(a) == len(b)
+for x, y in zip(a, b):
+    assert np.array_equal(np.asarray(x), np.asarray(y)), \
+        "save_names trajectory diverged from the none twin"
+tn = base["memory"]["programs"]["round"][0]["temp_bytes"]
+ts = named["memory"]["programs"]["round"][0]["temp_bytes"]
+assert ts <= tn, (ts, tn)
+print("memory-tier smoke OK: save_names bitwise == none; round temp "
+      f"bytes {ts} <= {tn}; memory row populated on both runs")
+EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "memory-tier smoke FAILED (rc=$rc)"
   exit "$rc"
 fi
 
