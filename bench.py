@@ -1724,6 +1724,96 @@ def measure_round_gap() -> dict:
     }
 
 
+def measure_async() -> dict:
+    """Semi-synchronous rounds A/B (ISSUE 16): K=0 vs K=1 on the CPU
+    mesh plus the sim-lab staleness-vs-convergence curves.
+
+    The K=0 arm runs TWICE and asserts run-to-run bitwise identity (the
+    staleness machinery is structurally absent at K=0 — same programs,
+    same schedule as the pre-staleness engine).  The K=1 arm reports the
+    delivered sync walls against how much of them the overlap hid
+    (``sync_hidden_ms`` / ``results["async_rounds"]``).  On a CPU
+    backend K>0 needs the sequential collective scheduler pinned before
+    jax initialized (the driver fails fast otherwise); when it is not —
+    e.g. mid-sweep without the flag — the K=1 arm is skipped with a
+    status instead of erroring the entry.  The sim curves run K∈{0,1,2}
+    across the paper's 2x3 balanced/disbalanced x topology matrix on the
+    1-device anchor mesh (no collective scheduler involved)."""
+    import jax
+    import numpy as np
+
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+    from learning_deep_neural_network_in_distributed_computing_environment_tpu.xla_flags import (
+        sequential_cpu_collectives_pinned)
+
+    n = len(jax.devices())
+    kw = dict(model="mlp", dataset="mnist", epochs_global=5,
+              epochs_local=1, batch_size=64, limit_train_samples=2048,
+              limit_eval_samples=256, compute_dtype="float32",
+              augment=False, aggregation_by="weights",
+              proportionality="uniform", seed=0)
+
+    def run(k):
+        return train_global(
+            Config(sync_staleness=k, **kw), progress=False,
+            # probe + walls pinned so every arm partitions identically
+            simulated_durations=np.ones(n),
+            simulated_round_durations=lambda e: np.ones(n))
+
+    out: dict = {"rounds": kw["epochs_global"]}
+    if n >= 2:
+        a, b = run(0), run(0)
+        out["k0_bitwise"] = bool(all(
+            a[key] == b[key]
+            for key in ("global_train_losses", "global_val_accuracies",
+                        "step_caps", "shard_sizes")))
+        sync0 = [t["sync_ms"] for t in a["round_timings"][1:]]
+        out["k0_sync_ms"] = round(float(np.mean(sync0)), 2) if sync0 \
+            else None
+        k1_ok = (jax.default_backend() != "cpu"
+                 or sequential_cpu_collectives_pinned())
+        if k1_ok:
+            r1 = run(1)
+            ar = r1["async_rounds"]
+            out["k1"] = {
+                "delivered": ar["delivered"],
+                "sync_ms_total": ar["sync_ms_total"],
+                "sync_hidden_ms_total": ar["sync_hidden_ms_total"],
+                "hidden_fraction": ar["hidden_fraction"],
+            }
+        else:
+            out["k1"] = {"status": "skipped_unpinned_cpu_scheduler"}
+    else:
+        out["k0_bitwise"] = None
+        out["k1"] = {"status": "skipped_single_device"}
+
+    # sim-lab convergence curves: K in {0,1,2} x {balanced,disbalanced}
+    # x {allreduce,ring,double_ring} — final val accuracy per curve, the
+    # full per-round curve for the balanced allreduce column
+    skw = dict(model="mlp", dataset="mnist", epochs_global=5,
+               epochs_local=1, batch_size=16, limit_train_samples=256,
+               limit_eval_samples=64, compute_dtype="float32",
+               augment=False, aggregation_by="weights", seed=0,
+               sim_workers=16)
+    curves: dict = {}
+    for mode in ("balanced", "disbalanced"):
+        for topo in ("allreduce", "ring", "double_ring"):
+            cell: dict = {}
+            for k in (0, 1, 2):
+                res = train_global(
+                    Config(**skw, data_mode=mode, topology=topo,
+                           sim_staleness=k), progress=False)
+                acc = [round(v, 2)
+                       for v in res["global_val_accuracies"]]
+                cell[f"k{k}"] = (acc if (mode, topo)
+                                 == ("balanced", "allreduce")
+                                 else acc[-1])
+            curves[f"{mode[:4]}_{topo}"] = cell
+    out["sim_curves"] = curves
+    return out
+
+
 def measure_torch_cpu_baseline() -> float:
     """images/sec for the reference-architecture torch train step on CPU
     (the reference's only runnable stack — BASELINE.md).  Median of 3 chains
@@ -1845,6 +1935,7 @@ SHORT = {
     "elastic_membership": "elastic",
     "crash_recovery": "recover",
     "sim_lab": "sim",
+    "async_rounds": "async",
 }
 
 
@@ -1889,6 +1980,8 @@ def _run_entry(key: str, entry_budget: float | None = None) -> dict:
         return measure_recover()
     if key == "sim_lab":
         return measure_sim()
+    if key == "async_rounds":
+        return measure_async()
     for k, name, shape, batch, steps, ncls, tok, _tmo, *extra in LADDER:
         if k == key:
             return measure_model(name, shape, batch, steps, ncls, tok,
@@ -2035,6 +2128,12 @@ def _emit_headline(details: dict, extra: dict) -> None:
                      "wx": e.get("sim_vs_real_wall"),
                      "same": 1 if e.get("bitwise_sim_eq_real_mesh")
                      else 0}
+        elif key == "async_rounds":
+            k1 = e.get("k1") or {}
+            d[sk] = {"hid": k1.get("hidden_fraction"),
+                     "sms": k1.get("sync_ms_total"),
+                     "hms": k1.get("sync_hidden_ms_total"),
+                     "same": 1 if e.get("k0_bitwise") else 0}
         elif key == "flash_attention":
             def _flash_cell(r):
                 if "train_flash_speedup" not in r:
@@ -2145,7 +2244,8 @@ def main() -> None:
                         ("ckpt_engine", 120), ("serve_engine", 120),
                         ("elastic_membership", 150),
                         ("crash_recovery", 180),
-                        ("sim_lab", 150)]
+                        ("sim_lab", 150),
+                        ("async_rounds", 150)]
                        + [(f"flash:L{L}", t) for L, _b, t in FLASH_POINTS])
     for key, tmo in jobs:
         rem = _remaining()

@@ -405,6 +405,53 @@ def _wire_codec(wdt):
 
 
 # --------------------------------------------------------------------------
+# Semi-synchronous delivery blend (ISSUE 16)
+# --------------------------------------------------------------------------
+# Under ``--sync_staleness K`` the standalone sync program no longer hands
+# its blend straight back as the next round's params — round R+1 has
+# already dispatched off the PRE-sync params T_R by the time sync R
+# finishes.  Instead the sync emits the consensus DELTA
+#
+#     D_R = blend(T_R) - T_R
+#
+# and the engine folds it into whatever params exist when the delta is
+# delivered (the entry of round R+K+1):  params' = params + D_R.  The two
+# halves below are the whole contract:
+#
+# * additivity is what makes the schedule composable — K deltas in flight
+#   fold in any params state without re-reading T_R (whose buffers round
+#   R+1's donated round program has already consumed);
+# * at K=0 the pair is exact identity in fp32 IF the engine skips it
+#   entirely (x + (b - x) == b does NOT hold bitwise in floating point),
+#   which is why the K=0 path never routes through these helpers — the
+#   bitwise gate is structural, not arithmetic;
+# * EF residuals compose because the residual update is a function of the
+#   sync's OWN wire rounding, computed inside the sync program against
+#   T_R — the delta just carries the post-EF blend's displacement;
+# * weighted (straggler-proportional) blends compose for the same reason:
+#   the blend weights are resolved inside the sync program, the delta is
+#   its output displacement;
+# * scatter-resident params do NOT compose (delivery needs full
+#   replicated trees on both sides) — config rejects / auto-demotes.
+
+
+def stale_delta(blended: PyTree, base: PyTree) -> PyTree:
+    """Consensus displacement ``blended - base`` per leaf, in the leaf's
+    own dtype — the payload a stale sync program returns instead of the
+    blend itself (``base`` is the pre-sync params snapshot the sync was
+    computed from)."""
+    return jax.tree_util.tree_map(lambda b, t: b - t, blended, base)
+
+
+def deliver_stale(params: PyTree, delta: PyTree) -> PyTree:
+    """Fold a stale consensus delta into freshly trained params:
+    ``params + delta`` per leaf.  Pure elementwise math — the engine jits
+    it with both inputs donated (the delta dies here; the params buffer
+    is replaced by the delivered tree)."""
+    return jax.tree_util.tree_map(lambda p, d: p + d, params, delta)
+
+
+# --------------------------------------------------------------------------
 # Sharded round sync: flatten-and-bucket -> reduce-scatter -> scale the
 # 1/N shard -> all-gather (ISSUE 2 tentpole)
 # --------------------------------------------------------------------------

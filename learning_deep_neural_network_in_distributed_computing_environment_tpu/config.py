@@ -162,6 +162,18 @@ class Config:
     # round delayed in BOTH modes, so overlapped and serial runs produce
     # identical results (False = fully serial, for debugging/benchmarks).
     overlap_rounds: bool = True
+    # Semi-synchronous rounds (ISSUE 16): K > 0 dispatches round R+1's
+    # local phase immediately off the PRE-sync params while round R's
+    # standalone sync program runs concurrently on device; the sync's
+    # output is carried as a consensus DELTA (blend - pre-sync params)
+    # and folded into the freshly trained params at the entry of round
+    # R+K+1 — at most K sync programs are in flight under any round's
+    # compute.  K = 0 is today's fully synchronous engine, bitwise.
+    # Weights (FedAvg) aggregation only; the v1 combos that cannot
+    # compose (chaos faults, elastic membership, multi-slice DCN,
+    # scatter-resident params, buddy redundancy, streamed rounds,
+    # checkpointing) are rejected eagerly below with the real reasons.
+    sync_staleness: int = 0
     # Persistent XLA compilation cache directory ("" = disabled).  The
     # CLI defaults this to .jax_cache so bench/multi-run invocations on
     # one host stop paying recompiles; library/test callers opt in.
@@ -374,6 +386,13 @@ class Config:
     # for the run — heterogeneous-tuning scenarios.  0 = off (the real
     # path's arithmetic, byte-for-byte).
     sim_lr_jitter: float = 0.0       # [0, 1)
+    # Simulated staleness (ISSUE 16): the scenario lab's twin of
+    # --sync_staleness — each round's consensus delta is queued and
+    # folded in K rounds late, so staleness-vs-convergence is
+    # characterized across the 2x3 balanced/disbalanced x topology
+    # matrix on one chip before any hardware is rented.  Requires
+    # --sim_workers; 0 = synchronous (the unmodified lab, bitwise).
+    sim_staleness: int = 0
 
     def __post_init__(self) -> None:
         _choices("backend", self.backend, ("jax", "gloo", "nccl", "mpi"))
@@ -699,6 +718,96 @@ class Config:
                     "--sim_workers: the ring/zigzag attention kernels "
                     "run over a real 'seq' mesh axis (see the inner-"
                     "mesh-axes rejection)")
+        # --- semi-synchronous rounds (ISSUE 16): eager v1 limits ---------
+        if self.sync_staleness < 0:
+            raise ValueError(
+                f"sync_staleness must be >= 0 (0 = fully synchronous), "
+                f"got {self.sync_staleness}")
+        if self.sim_staleness < 0:
+            raise ValueError(
+                f"sim_staleness must be >= 0 (0 = the synchronous lab), "
+                f"got {self.sim_staleness}")
+        if self.sim_staleness > 0:
+            if self.sim_workers == 0:
+                raise ValueError(
+                    "--sim_staleness is a simulated-scenario knob; it "
+                    "needs --sim_workers N (the real engine's knob is "
+                    "--sync_staleness)")
+            if self.aggregation_by != "weights":
+                raise ValueError(
+                    "--sim_staleness requires --aggregation_by weights: "
+                    "in gradients mode every worker applies its own "
+                    "optimizer to the aggregate inside the round — there "
+                    "is no between-round consensus blend whose delivery "
+                    "could be deferred")
+        if self.sync_staleness > 0:
+            if self.sim_workers > 0:
+                raise ValueError(
+                    "--sync_staleness cannot combine with --sim_workers: "
+                    "the real engine's staleness overlaps a REAL "
+                    "standalone sync program under the next round's "
+                    "device compute — the lab's sync is stacked math "
+                    "inside the one round program (use --sim_staleness "
+                    "for the simulated delivery-delay twin)")
+            if self.aggregation_by != "weights":
+                raise ValueError(
+                    "--sync_staleness requires --aggregation_by weights "
+                    "(FedAvg): the deferred delivery folds a consensus "
+                    "DELTA into later params, which needs a consensus "
+                    "blend to exist — in gradients mode the aggregate "
+                    "feeds each worker's optimizer step inside the round "
+                    "and there is nothing to deliver late")
+            if self.chaos:
+                raise ValueError(
+                    "--chaos cannot combine with --sync_staleness in v1: "
+                    "crash rollback and elastic membership both rebuild "
+                    "state at a round boundary assuming NO consensus is "
+                    "in flight — a pending stale delta would be computed "
+                    "against a pre-crash (or pre-reshard) worker axis and "
+                    "silently corrupt the restored params (per-fault "
+                    "drain is the ROADMAP follow-on)")
+            if self.num_slices > 1:
+                raise ValueError(
+                    "--num_slices > 1 cannot combine with "
+                    "--sync_staleness in v1: the hierarchical sync "
+                    "threads a DCN outer-EF residual through consecutive "
+                    "sync programs — under staleness sync R+1 dispatches "
+                    "before sync R's residual exists, so the two-level "
+                    "chain cannot pipeline without restructuring the "
+                    "outer hop (the ROADMAP follow-on)")
+            if self.param_residency == "resident":
+                raise ValueError(
+                    "--param_residency resident cannot combine with "
+                    "--sync_staleness: resident keeps the sync's scatter "
+                    "output as the between-round state, which makes round "
+                    "R+1's entry gather DEPEND on sync R finishing — the "
+                    "exact serialization staleness exists to remove "
+                    "(auto resolves to replicated)")
+            if self.shard_redundancy == "buddy":
+                raise ValueError(
+                    "--shard_redundancy buddy cannot combine with "
+                    "--sync_staleness: the buddy hop rides the sync "
+                    "program to snapshot shard-resident state, and "
+                    "staleness resolves param residency to replicated — "
+                    "nothing is uniquely held, so there is nothing to "
+                    "back up (its consumer, crash recovery, is rejected "
+                    "under staleness anyway)")
+            if self.stream_chunk_steps > 0:
+                raise ValueError(
+                    "--stream_chunk_steps cannot combine with "
+                    "--sync_staleness in v1: the streamed round already "
+                    "overlaps its standalone sync under the next round's "
+                    "first chunks via the producer thread — composing a "
+                    "second staleness window over the chunked dispatch "
+                    "is the ROADMAP follow-on")
+            if self.checkpoint_dir or self.resume:
+                raise ValueError(
+                    "--checkpoint_dir/--resume cannot combine with "
+                    "--sync_staleness in v1: a snapshot taken between "
+                    "fences would capture params WITHOUT the K in-flight "
+                    "consensus deltas, so the restored trajectory would "
+                    "silently diverge from the run that wrote it "
+                    "(drain-before-snapshot is the ROADMAP follow-on)")
 
     # Convenience ----------------------------------------------------------
     def replace(self, **kw: Any) -> "Config":
@@ -836,6 +945,11 @@ class Config:
         the sync still ends at the inner scatter, so each worker keeps
         its 1/W bucket shard of its own slice's consensus (exactly
         1/N_inner between rounds, the ISSUE 13 composition contract)."""
+        if self.sync_staleness > 0:
+            # resident would make round R+1's entry gather depend on
+            # sync R finishing — the serialization staleness removes
+            # (explicit resident x staleness is rejected eagerly)
+            return "replicated"
         if self.resolve_sync_mode(backend) not in ("sharded", "hier"):
             return "replicated"
         if self.resolve_opt_placement(backend) != "sharded":
@@ -1169,6 +1283,14 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="disable the overlapped round pipeline (serial "
                         "fetch/assemble/re-partition between rounds; same "
                         "results, larger device gap)")
+    p.add_argument("--sync_staleness", type=int, default=d.sync_staleness,
+                   help="semi-synchronous rounds: dispatch the next "
+                        "round's local phase off the pre-sync params "
+                        "while the standalone sync runs concurrently, "
+                        "folding each consensus delta in K rounds late "
+                        "(at most K syncs in flight; 0 = fully "
+                        "synchronous, bitwise today's engine; weights "
+                        "aggregation only)")
     p.add_argument("--compile_cache_dir", type=str, default=".jax_cache",
                    help="persistent XLA compilation cache directory "
                         "('' disables); repeated runs on one host skip "
@@ -1344,6 +1466,11 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="scenario: per-worker LR spread — worker i "
                         "trains at lr*(1 + jitter*u_i), u_i a seeded "
                         "uniform[-1,1) draw fixed for the run")
+    p.add_argument("--sim_staleness", type=int, default=d.sim_staleness,
+                   help="scenario: deliver each round's consensus delta "
+                        "K rounds late — the lab twin of "
+                        "--sync_staleness for staleness-vs-convergence "
+                        "curves (0 = synchronous)")
     return p
 
 

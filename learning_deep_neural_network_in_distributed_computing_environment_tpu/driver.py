@@ -730,6 +730,23 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
         train_kw.update(attention_impl=cfg.attention_impl)
     if train_kw:
         train_model = build_model_for(cfg, num_classes, **base_kw, **train_kw)
+    if cfg.sync_staleness > 0 and jax.default_backend() == "cpu":
+        # semi-synchronous rounds keep a standalone sync program running
+        # CONCURRENTLY with the next round program — on an unpinned
+        # XLA:CPU backend the concurrency-optimized thunk executor can
+        # join the two programs' collectives in different per-device
+        # orders and deadlock (the SP x PP hazard, same mechanism) —
+        # fail fast with instructions instead of a 40 s hang + SIGABRT
+        from .xla_flags import (SEQUENTIAL_CPU_COLLECTIVES_FLAG,
+                                sequential_cpu_collectives_pinned)
+        if not sequential_cpu_collectives_pinned():
+            raise RuntimeError(
+                "--sync_staleness on the CPU backend needs the "
+                "sequential collective scheduler pinned BEFORE jax "
+                "initializes: set "
+                f"XLA_FLAGS={SEQUENTIAL_CPU_COLLECTIVES_FLAG} (the CLI "
+                "--device cpu, tests/conftest.py, and "
+                "__graft_entry__.py do this automatically)")
     if sim_on:
         # param_specs_fn / nan_screen are real-mesh machinery (inner
         # axes and --chaos were both rejected at config time)
@@ -1024,9 +1041,16 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     # find the previous round fully settled (its wall recorded, so the
     # straggler verdict and the EMA the snapshot captures are final)
     # before the state is snapshotted and the mesh rebuilt.
+    # Staleness (ISSUE 16) keeps its own in-flight chain — up to K sync
+    # programs under the round's compute, tracked engine-side — and its
+    # handles carry no sync fence for the deep pipeline's deferred-round
+    # marker bookkeeping to ride; the two overlap disciplines do not
+    # compose in v1, and staleness is the stronger one (it hides the
+    # sync wall, the deep pipeline's whole win on these meshes).
     deep_pipeline = (overlap and not streaming
                      and jax.default_backend() != "cpu"
-                     and not sanitize and schedule is None)
+                     and not sanitize and schedule is None
+                     and cfg.sync_staleness == 0)
 
     def build_inputs(tparts, vparts, caps):
         if streaming:
@@ -1814,6 +1838,19 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     results["sync_engine"]["sync_bytes_ici"] = ici_b
     results["sync_engine"]["sync_bytes_dcn"] = dcn_b
 
+    # semi-synchronous drain (ISSUE 16): the round loop exits with up to
+    # K consensus deltas still in flight — fold every one of them into
+    # the params (oldest first, the same delivery blend the in-loop
+    # fences use) and restore the engine-held EF residual into the state
+    # BEFORE anything below reads it (the memory accounting's
+    # state_resident_bytes, results["state"], rank0_variables).  The
+    # drain walls land in engine.stale_log, not in round_timings — the
+    # async_rounds summary below covers them.  The sim twin
+    # (--sim_staleness) drains the same way, minus the wall accounting.
+    if (getattr(engine, "staleness", 0) > 0
+            or getattr(engine, "sim_staleness", 0) > 0):
+        state = engine.drain_pending(state)
+
     # compiled-memory observability (ISSUE 15): recorded like
     # sync_engine / sanitize — every run artifact carries XLA's
     # memory_analysis of every cached executable this run compiled
@@ -1901,6 +1938,34 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
                  results["sim"]["workers"],
                  results["sim"]["rounds_per_s"],
                  results["sim"]["per_worker_sync_bytes"])
+
+    # semi-synchronous provenance (ISSUE 16): recorded like sync_engine /
+    # sanitize — every run artifact states whether rounds overlapped
+    # their sync and how much of the consensus wall the overlap hid.
+    # "delivered" counts every dispatched sync (in-loop fences plus the
+    # drain above); hidden_fraction is the headline win — the fraction
+    # of the total measured sync wall the round loop never waited on.
+    if cfg.sync_staleness > 0:
+        stale_log = list(getattr(engine, "stale_log", []))
+        wall_total = sum(r["sync_ms"] for r in stale_log)
+        hidden_total = sum(r["sync_hidden_ms"] for r in stale_log)
+        results["async_rounds"] = {
+            "enabled": True,
+            "staleness": cfg.sync_staleness,
+            "delivered": len(stale_log),
+            "sync_ms_total": round(wall_total, 3),
+            "sync_hidden_ms_total": round(hidden_total, 3),
+            "hidden_fraction": (round(hidden_total / wall_total, 4)
+                                if wall_total > 0 else 0.0),
+        }
+        log.info("async rounds: staleness %d, %d consensus delta(s) "
+                 "delivered, %.1f ms sync wall, %.1f ms hidden under "
+                 "compute (%.0f%%)",
+                 cfg.sync_staleness, len(stale_log), wall_total,
+                 hidden_total,
+                 100.0 * results["async_rounds"]["hidden_fraction"])
+    else:
+        results["async_rounds"] = {"enabled": False}
 
     results["state"] = state
     # the rank-0 eval variables, residency-agnostic (ISSUE 11): a

@@ -62,6 +62,13 @@ from .train import LocalSGDEngine, TrainState
 
 log = logging.getLogger(__name__)
 
+# built under jit: eager zeros_like materializes scalar constants
+# host-side — a transfer the sanitizer's guard (correctly) disallows
+# inside the round loop; cached at module level so the callable is
+# constructed once (graftlint R2)
+_zeros_like_tree = jax.jit(
+    lambda t: jax.tree_util.tree_map(jnp.zeros_like, t))
+
 
 def _row_where(mask_rows: jnp.ndarray, a, b):
     """Per-worker row select on worker-stacked pytrees: ``mask_rows`` is
@@ -147,6 +154,23 @@ class SimEngine(LocalSGDEngine):
             self.lr_scale = None
         # per-round scenario telemetry, assembled into results["sim"]
         self.rounds_scenario: list[dict] = []
+        # --- semi-synchronous twin (ISSUE 16) --------------------------
+        # --sim_staleness K models the REAL engine's delayed-delivery
+        # schedule (train.py's staleness state machine) as pure stacked
+        # math, so staleness-vs-convergence is characterized across the
+        # paper's 2x3 matrix on one chip before any hardware is rented.
+        # The round program always takes a delta_in input (a cached
+        # zeros tree during the first K+1 warmup rounds — one program,
+        # no retrace) and emits delta_out = delivered - trained while
+        # params stay at the TRAINED value; the host-side deque below
+        # applies the real schedule: round R's delta folds in at the
+        # entry of round R+K+1, drain at exit.  The base engine's
+        # overlap machinery stays off (self.staleness == 0): the sim
+        # sync is fused math with no wall to hide — this arm is the
+        # CONVERGENCE twin, not the wall-clock one.
+        self.sim_staleness = max(0, int(cfg.sim_staleness))
+        self._sim_pending: list = []
+        self._sim_zeros = None
 
     # ------------------------------------------------------------------
     # Telemetry
@@ -175,6 +199,10 @@ class SimEngine(LocalSGDEngine):
         self.last_sync_stats = {"sync_bytes": self._sync_bytes,
                                 "sync_mode": self.sync_mode,
                                 "sync_ms": 0.0,
+                                # schema twin of the real rows (ISSUE
+                                # 16): the fused sim sync has no wall to
+                                # hide, so the column is always zero
+                                "sync_hidden_ms": 0.0,
                                 "sync_bytes_ici": ici,
                                 "sync_bytes_dcn": dcn,
                                 "sync_ms_ici": 0.0,
@@ -222,6 +250,7 @@ class SimEngine(LocalSGDEngine):
         local_round = self._make_local_round(augment)
         weights_mode = cfg.aggregation_by == "weights"
         scenario = self.scenario_on
+        stale = self.sim_staleness > 0
         byz_rows = (jnp.asarray(self._byz_mask()) if self.byz_count
                     else None)
         lr_scale = (jnp.asarray(self.lr_scale)
@@ -266,6 +295,13 @@ class SimEngine(LocalSGDEngine):
                 contrib)
 
         def sim_round(state: TrainState, x, y, m, xv, yv, mv, *scen):
+            if stale:
+                # deliver the due (possibly zero) stale consensus delta
+                # into the params this round trains off — the twin of
+                # the real engine's _stale_enter fold
+                scen, delta_in = scen[:-1], scen[-1]
+                state = state.replace(params=comms.deliver_stale(
+                    state.params, delta_in))
             entry = (state.params, state.batch_stats, state.opt_state,
                      state.lr_epoch, state.rng)
             args = entry + (x, y, m, xv, yv, mv)
@@ -293,6 +329,7 @@ class SimEngine(LocalSGDEngine):
             # --- the sync point: pure stacked math ---------------------
             agg_grad_norm = jnp.zeros((n,))
             residual = state.sync_residual
+            delta_out = None
             agg_kw = dict(how=cfg.aggregation_type,
                           topology=cfg.topology,
                           local_weight=cfg.local_weight,
@@ -307,8 +344,16 @@ class SimEngine(LocalSGDEngine):
                     residual = state.sync_residual
                 # dropped rows miss the consensus too; everyone else
                 # (incl. sampled-out and adversarial rows) adopts
-                params = (_row_where(dropped, params, blended)
-                          if scenario else blended)
+                delivered = (_row_where(dropped, params, blended)
+                             if scenario else blended)
+                if stale:
+                    # ISSUE 16: emit the consensus displacement instead
+                    # of adopting it — params stay at the trained value
+                    # and the host delivers delta_out K+1 rounds later
+                    # (a dropped row's delta is exactly zero)
+                    delta_out = comms.stale_delta(delivered, params)
+                else:
+                    params = delivered
             else:
                 contrib = (last_grads if not scenario
                            else corrupt(last_grads, None, noise_key))
@@ -333,8 +378,12 @@ class SimEngine(LocalSGDEngine):
                                    opt_state=opt_state,
                                    lr_epoch=lr_epoch, rng=rng,
                                    sync_residual=residual)
+            if stale:
+                return new_state, metrics, delta_out
             return new_state, metrics
 
+        # delta_in (the last positional under staleness) is NOT donated:
+        # the warmup rounds reuse one cached zeros tree
         return jax.jit(sim_round, donate_argnums=(0,))
 
     # ------------------------------------------------------------------
@@ -363,6 +412,26 @@ class SimEngine(LocalSGDEngine):
             # vmap'd round executable's memory_analysis is what the
             # sim-lab N-ceiling measurement reads on a real chip
             self._track(key, self._build_round(key), "sim_round")
+            if self.sim_staleness > 0 and \
+                    "sim_deliver" not in self._round_cache:
+                # the drain's delivery fold, AOT-compiled NOW (round 0 =
+                # inside the sanitizer's warmup window — its first call
+                # runs after the loop, where a fresh compile would bust
+                # the zero-post-warmup-retrace budget)
+                tp = self._track("sim_deliver",
+                                 jax.jit(comms.deliver_stale,
+                                         donate_argnums=(0,)),
+                                 "sim_deliver")
+                try:
+                    spec = jax.tree_util.tree_map(
+                        lambda a: jax.ShapeDtypeStruct(
+                            a.shape, a.dtype, sharding=a.sharding),
+                        state.params)
+                    tp.compiled = tp._fn.lower(spec, spec).compile()
+                except Exception as e:  # noqa: BLE001 — TrackedProgram
+                    # falls back to plain jit on first call
+                    log.warning("sim deliver pre-compile unavailable: "
+                                "%s", e)
         extra = ()
         if self.scenario_on:
             active, dropped, noise_key = self._draw_scenario()
@@ -374,10 +443,37 @@ class SimEngine(LocalSGDEngine):
             extra = (self._put(active, self._spec),
                      self._put(dropped, self._spec),
                      jax.device_put(noise_key))
-        new_state, metrics = self._round_cache[key](
-            state, x, y, m, xv, yv, mv, *extra)
+        if self.sim_staleness > 0:
+            # the real engine's delivery schedule, host-side: round R's
+            # delta folds in at the entry of round R+K+1 (one delta is
+            # appended per round, so at most one comes due here); the
+            # first K+1 rounds deliver a cached zeros tree so ONE
+            # program serves every round
+            if self._sim_zeros is None:
+                self._sim_zeros = _zeros_like_tree(state.params)
+            delta_in = (self._sim_pending.pop(0)
+                        if len(self._sim_pending) > self.sim_staleness
+                        else self._sim_zeros)
+            extra = extra + (delta_in,)
+            new_state, metrics, delta_out = self._round_cache[key](
+                state, x, y, m, xv, yv, mv, *extra)
+            self._sim_pending.append(delta_out)
+        else:
+            new_state, metrics = self._round_cache[key](
+                state, x, y, m, xv, yv, mv, *extra)
         self._arm_sync_stats(new_state.params)
         return new_state, ("packed", metrics, None, None, None)
+
+    def drain_pending(self, state: TrainState) -> TrainState:
+        """End-of-run fence (ISSUE 16 sim twin): fold every still-pending
+        consensus delta (oldest first) so the final state reflects every
+        simulated sync — the same drain contract as the real engine."""
+        while self._sim_pending:
+            delta = self._sim_pending.pop(0)
+            params = self._round_cache["sim_deliver"](state.params, delta)
+            state = state.replace(params=params)
+        return (jax.block_until_ready(state) if self.sim_staleness
+                else state)
 
     def round_streamed_start(self, state, train_chunks, val_chunks,
                              poison=None):
@@ -402,6 +498,8 @@ class SimEngine(LocalSGDEngine):
             "round_ms": [round(c, 3) for c in comp],
             "per_worker_state_bytes": self.state_resident_bytes(state),
             "per_worker_sync_bytes": int(self._sync_bytes or 0),
+            # ISSUE 16: the delayed-delivery twin's K (0 = synchronous)
+            "staleness": self.sim_staleness,
             "scenario": {
                 "sample_frac": cfg.sim_sample_frac,
                 "dropout": cfg.sim_dropout,

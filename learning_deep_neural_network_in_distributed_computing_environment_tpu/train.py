@@ -42,6 +42,7 @@ TPU-first design (not a translation):
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 import time
@@ -672,6 +673,35 @@ class LocalSGDEngine:
         # round, which gives a measurable per-round collective wall and
         # the two-rounds-in-flight dispatch chain (driver deep pipeline).
         self.split_sync = jax.default_backend() != "cpu"
+        # --- semi-synchronous rounds (ISSUE 16) -------------------------
+        # K > 0: round R+1 dispatches off the PRE-sync params while sync
+        # R runs concurrently; its consensus DELTA (comms.stale_delta) is
+        # folded in at the entry of round R+K+1.  The window IS the
+        # standalone sync program running under the next round's compute,
+        # so the split is forced even on XLA:CPU (the driver fails fast
+        # there unless the sequential collective scheduler is pinned —
+        # xla_flags.py).  K = 0 leaves every path below untouched: the
+        # bitwise gate is structural.
+        self.staleness = max(0, int(getattr(cfg, "sync_staleness", 0)))
+        if self.staleness > 0:
+            self.split_sync = True
+        # FIFO of in-flight stale sync records, oldest first (at most K
+        # under any round's compute; drained by drain_pending)
+        self._pending: list[dict] = []
+        # per-delivery walls, in delivery order — the driver's
+        # results["async_rounds"] summary reads this
+        self.stale_log: list[dict] = []
+        # under staleness the EF residual is threaded ENGINE-side from
+        # sync program to sync program (state.sync_residual is stripped
+        # to None so the round program neither donates nor retraces on
+        # it); restored into the state at drain
+        self._stale_residual = None
+        self._delivered_stats: dict | None = None
+        # gate knob: dispatch the SAME delayed-blend schedule but block
+        # on every sync fence at dispatch — a scheduling-only change the
+        # K=1 bitwise gate diffs against the overlapped run
+        self.staleness_serial = bool(
+            os.environ.get("JAX_GRAFT_STALENESS_SERIAL"))
         self.last_sync_stats: dict | None = None
         self._sync_probe = None      # (ready_marker | None, sync_out_ref)
         self._sync_bytes: int | None = None
@@ -915,6 +945,14 @@ class LocalSGDEngine:
         self.last_sync_stats = {"sync_bytes": self._sync_bytes,
                                 "sync_mode": self.sync_mode,
                                 "sync_ms": 0.0,
+                                # ISSUE 16: portion of the sync wall that
+                                # ran hidden under the next round's
+                                # compute — zero-filled on synchronous
+                                # runs (same convention as sync_ms); under
+                                # staleness, row R+K+1 carries sync R's
+                                # DELIVERED walls (the round at whose
+                                # fence the delta landed)
+                                "sync_hidden_ms": 0.0,
                                 # per-level split (ISSUE 13): identical
                                 # schema on every engine — flat rounds
                                 # report all bytes as the intra-slice
@@ -1978,10 +2016,26 @@ class LocalSGDEngine:
             poison = self.stage_poison(np.zeros(self.n_workers, np.bool_))
         extra = ((poison,) if self.nan_screen and not self.split_sync
                  else ())
+        if self.staleness > 0:
+            # semi-synchronous entry (ISSUE 16): fold every DUE stale
+            # consensus delta into the params this round is about to
+            # train, then dispatch the round off them — the still-young
+            # syncs keep running under its compute
+            state = self._stale_enter(state)
         outs = self._round_cache[key](state, x, y, m, xv, yv, mv, *extra)
         new_state, metrics = outs[0], outs[-1]
         self._arm_sync_stats(new_state.params)
         sync_norm = fence = sync_ok = None
+        if self.staleness > 0:
+            # dispatch this round's sync as a stale record (primary NOT
+            # donated — the next round's program donates those buffers;
+            # the delta is delivered K rounds later) and surface the
+            # walls of whatever delivery just landed in THIS row
+            self._stale_dispatch(new_state, metrics)
+            if self._delivered_stats is not None:
+                self.last_sync_stats.update(self._delivered_stats)
+                self._delivered_stats = None
+            return new_state, ("packed", metrics, None, None, None)
         if self.split_sync:
             # the sync program consumes the round's outputs, so its
             # dispatch chains behind the still-running round program; the
@@ -2052,6 +2106,189 @@ class LocalSGDEngine:
                 self.last_sync_stats["sync_ms_ici"] = ici_ms
                 self.last_sync_stats["sync_ms_dcn"] = dcn_ms
         return jax.block_until_ready(new_state)
+
+    # ------------------------------------------------------------------
+    # Semi-synchronous rounds (ISSUE 16): the staleness state machine
+    # ------------------------------------------------------------------
+    # round_start under K > 0 runs three phases:
+    #   1. _stale_enter  — deliver every DUE consensus delta (oldest
+    #      first, while more than K are pending) into the params the
+    #      round is about to train;
+    #   2. dispatch the (donated) round program off the delivered params;
+    #   3. _stale_dispatch — dispatch this round's sync program on the
+    #      round's trained output WITHOUT donating it (the next round's
+    #      program donates those buffers; the PJRT runtime orders its
+    #      write after the sync's read because the sync dispatched
+    #      first), record the in-flight {delta, fence} pair.
+    # The schedule: round R's delta lands at the entry of round R+K+1,
+    # so at most K sync programs run under any round's compute, and a
+    # K=1 run is one round stale everywhere.  The host never blocks on
+    # a sync fence except at delivery — the exposed remainder of the
+    # wall — which is how sync_hidden_ms is measured.
+
+    def _stale_enter(self, state: TrainState) -> TrainState:
+        """Entry-of-round staleness work: move the EF residual
+        engine-side (first round only — the round program must neither
+        donate nor retrace on it) and deliver every due delta."""
+        if self.sync_ef and state.sync_residual is not None:
+            self._stale_residual = state.sync_residual
+            state = state.replace(sync_residual=None)
+        while len(self._pending) > self.staleness:
+            state = self._deliver_oldest(state)
+        return state
+
+    def _deliver_oldest(self, state: TrainState) -> TrainState:
+        """Fold the oldest in-flight consensus delta into the current
+        params (comms.deliver_stale, both inputs donated) and measure
+        the delivery accounting: ``exposed_ms`` is the host block on the
+        delta (zero when the sync finished under compute), ``hidden_ms``
+        the remainder of the sync wall the overlap absorbed."""
+        rec = self._pending.pop(0)
+        t0 = time.perf_counter()
+        jax.block_until_ready(rec["delta"])
+        exposed_ms = (time.perf_counter() - t0) * 1e3
+        rec["thread"].join()
+        wall_ms = rec["wall_ms"]
+        # serial gate mode blocked the whole wall at dispatch: nothing
+        # was hidden, whatever the delivery-time arithmetic says
+        hidden_ms = (0.0 if self.staleness_serial
+                     else max(0.0, wall_ms - exposed_ms))
+        params = self._round_cache["deliver"](state.params, rec["delta"])
+        ici_ms, dcn_ms = probe_lib.attribute_sync_wall(
+            round(wall_ms, 3), *self._sync_bytes_split)
+        self._delivered_stats = {"sync_ms": round(wall_ms, 3),
+                                 "sync_hidden_ms": round(hidden_ms, 3),
+                                 "sync_ms_ici": ici_ms,
+                                 "sync_ms_dcn": dcn_ms}
+        self.stale_log.append({"sync_ms": round(wall_ms, 3),
+                               "sync_hidden_ms": round(hidden_ms, 3),
+                               "exposed_ms": round(exposed_ms, 3)})
+        return state.replace(params=params)
+
+    def _stale_dispatch(self, new_state: TrainState, metrics) -> None:
+        """Dispatch the staleness sync program on a round's trained
+        params and enqueue its in-flight record.  A watcher thread times
+        the sync's own execution wall (block the round marker, then the
+        fence — the same two-block probe the synchronous engine uses),
+        so the wall is measurable even though the dispatch thread never
+        waits for it."""
+        if "stale_sync" not in self._round_cache:
+            self._round_cache["stale_sync"] = self._build_stale_sync()
+            # AOT-compile the delivery program NOW (round 0 = inside
+            # every warmup window): the first delivery runs at round
+            # K+1's entry, where a fresh trace would bust the
+            # sanitizer's zero-post-warmup-retrace budget
+            # only the params donate: the delta has no same-shaped
+            # second output to alias into (it frees when the host
+            # drops the pending record)
+            tp = self._track("deliver",
+                             jax.jit(comms.deliver_stale,
+                                     donate_argnums=(0,)),
+                             "deliver")
+            try:
+                spec = jax.tree_util.tree_map(
+                    lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                   sharding=a.sharding),
+                    new_state.params)
+                tp.compiled = tp._fn.lower(spec, spec).compile()
+            except Exception as e:  # noqa: BLE001 — TrackedProgram
+                # falls back to plain jit on first call
+                log.warning("stale deliver pre-compile unavailable: %s", e)
+        args = [new_state.params]
+        if self.sync_ef:
+            # the EF residual chains sync-to-sync engine-side: sync R
+            # consumes (donates) sync R-1's residual output — the data
+            # dependency serializes the SYNC chain, never the rounds
+            args.append(self._stale_residual)
+        d = self._round_cache["stale_sync"](*args)
+        if self.sync_ef:
+            self._stale_residual = d["residual"]
+        rec = {"delta": d["delta"], "fence": d["fence"], "wall_ms": 0.0}
+        marker = metrics["train_loss"]
+        fence = d["fence"]
+
+        def _watch():
+            jax.block_until_ready(marker)
+            t0 = time.perf_counter()
+            jax.block_until_ready(fence)
+            rec["wall_ms"] = (time.perf_counter() - t0) * 1e3
+
+        t = threading.Thread(target=_watch, daemon=True,
+                             name="stale-sync-watch")
+        t.start()
+        rec["thread"] = t
+        self._pending.append(rec)
+        if self.staleness_serial:
+            # the K-bitwise gate's serial reference: same programs, same
+            # delayed-delivery schedule, zero overlap
+            jax.block_until_ready(fence)
+
+    def drain_pending(self, state: TrainState) -> TrainState:
+        """End-of-run fence: deliver every still-in-flight consensus
+        delta (oldest first) and restore the engine-side EF residual
+        into the state, so the trained result reflects every dispatched
+        sync.  No-op when staleness is off or nothing is pending."""
+        while self._pending:
+            state = self._deliver_oldest(state)
+        if self._stale_residual is not None:
+            state = state.replace(sync_residual=self._stale_residual)
+            self._stale_residual = None
+        return jax.block_until_ready(state) if self.staleness else state
+
+    def _build_stale_sync(self):
+        """The staleness variant of the standalone sync program (ISSUE
+        16).  Three contract changes against ``_build_sync``:
+
+        * the primary input (the freshly trained params) is NOT donated —
+          the next round's round program donates those buffers, and the
+          runtime orders that write after this program's read because
+          the sync dispatched first; the host side never re-reads them
+          (the graftlint R4 contract);
+        * the output is the consensus DELTA ``blend(T) - T``
+          (comms.stale_delta) instead of the blend itself — additive, so
+          it folds into whatever params exist at delivery without
+          touching T again;
+        * only the weights (FedAvg) x replicated-residency x unscreened
+          shape exists: config rejected every other combo eagerly, so
+          there is no resident / buddy / tracker / poison plumbing."""
+
+        def _fence(tree):
+            f = jnp.sum(jax.tree_util.tree_leaves(tree)[0]).astype(
+                jnp.float32)
+            return lax.psum(f, self._inner_axes) if self._inner_axes else f
+
+        pspec = self._sspec.params if self._sspec is not None else self._spec
+        takes_residual = self.sync_ef
+
+        def per_worker(*args):
+            primary = args[0]
+            residual = args[1] if takes_residual else None
+            p, _res, r, _t, _bud, _ok, _n, _o = self._sync_body(
+                primary, None, residual)
+            delta = comms.stale_delta(p, primary)
+            d = {"delta": delta, "fence": _fence(delta)}
+            if takes_residual:
+                d["residual"] = r
+            return d
+
+        in_specs = [pspec]
+        donate: tuple = ()
+        if takes_residual:
+            in_specs.append(pspec)
+            donate = (1,)
+        out_specs: dict = {"delta": pspec, "fence": self._spec}
+        if takes_residual:
+            out_specs["residual"] = pspec
+        prog = self._track(None,
+                           self._wrap_stacked(per_worker, in_specs,
+                                              out_specs=out_specs,
+                                              donate=donate),
+                           "stale_sync")
+
+        def run(*args):
+            return dict(prog(*args))
+
+        return run
 
     def checkpoint_fence(self, state: TrainState) -> TrainState:
         """Barrier a checkpoint snapshot needs before reading ``state``.

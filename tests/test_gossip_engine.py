@@ -224,8 +224,10 @@ class TestEngineGossip:
         # every engine, sync_ms zero-filled where no standalone sync
         # program ran (CPU fuses the sync into the round program).
         # ISSUE 13 widened the schema with the per-LEVEL split — flat
-        # engines report every byte as the intra-slice (ICI) level
-        keys = {"sync_bytes", "sync_mode", "sync_ms",
+        # engines report every byte as the intra-slice (ICI) level —
+        # and ISSUE 16 with sync_hidden_ms (zero-filled on synchronous
+        # runs)
+        keys = {"sync_bytes", "sync_mode", "sync_ms", "sync_hidden_ms",
                 "sync_bytes_ici", "sync_bytes_dcn",
                 "sync_ms_ici", "sync_ms_dcn"}
         assert set(eng_d.last_sync_stats) == keys

@@ -475,6 +475,42 @@ def sim_loop(sim_round, state, x, y, m):
 """
         assert "R4" not in rules_for(src)
 
+    def test_stale_presync_state_use_after_overlap_flagged(self):
+        # ISSUE 16 fixture: under --sync_staleness the stale sync
+        # program reads a round's trained state WITHOUT donating it
+        # while the NEXT round's program donates those same buffers —
+        # device-safe (the runtime orders the donating write after the
+        # already-dispatched sync's read) but host-unsafe: after the
+        # overlapped dispatch the donated pre-sync state must never be
+        # read again on the host, exactly the in-flight contract R4
+        # polices
+        src = """
+import jax
+def overlapped_rounds(round_prog, stale_sync, state, batch):
+    prog = jax.jit(round_prog, donate_argnums=(0,))
+    pending = stale_sync(state)     # in flight: reads, never donates
+    new_state = prog(state, batch)  # donates the same buffers
+    probe = state   # donated pre-sync state read after the dispatch
+    return new_state, pending, probe
+"""
+        assert "R4" in rules_for(src)
+
+    def test_stale_delivery_rebinds_to_blend_clean(self):
+        # the engine's real shape (train._deliver_oldest / the round
+        # loop): at the fence every consumer rebinds its state name to
+        # the delivery fold's output — the delivered blend replaces the
+        # donated generation before any further read
+        src = """
+import jax
+def overlapped_rounds(round_prog, stale_sync, deliver, state, batch):
+    prog = jax.jit(round_prog, donate_argnums=(0,))
+    pending = stale_sync(state)
+    state = prog(state, batch)
+    state = deliver(state, pending)   # the delivered blend
+    return state
+"""
+        assert "R4" not in rules_for(src)
+
     def test_rebound_name_no_longer_shard_map_clean(self):
         src = """
 import jax

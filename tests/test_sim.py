@@ -337,10 +337,13 @@ class TestEngineParity:
         ss = sim_e.init_state(jax.random.key(0), sample)
         ss, _ = sim_e.round(ss, make_packs(), make_packs(seed=1))
         stats = sim_e.last_sync_stats
-        # identical schema to every real engine's row
+        # identical schema to every real engine's row (ISSUE 16 added
+        # sync_hidden_ms, zero-filled everywhere but staleness runs)
         assert set(stats) == {"sync_bytes", "sync_mode", "sync_ms",
+                              "sync_hidden_ms",
                               "sync_bytes_ici", "sync_bytes_dcn",
                               "sync_ms_ici", "sync_ms_dcn"}
+        assert stats["sync_hidden_ms"] == 0.0
         assert stats["sync_mode"] == "sim"
         assert stats["sync_bytes"] == comms.sim_wire_bytes(
             sim_e.params_template, N, topology="allreduce")

@@ -283,6 +283,9 @@ class TestDriverTelemetry:
         for t in res["round_timings"]:
             assert t["sync_mode"] == "sharded"
             assert t["sync_bytes"] > 0
+            # ISSUE 16 schema: every row carries sync_hidden_ms, and a
+            # synchronous run zero-fills it (same convention as sync_ms)
+            assert t["sync_hidden_ms"] == 0.0
         # run-artifact engine provenance (ISSUE 9 satellite): sync mode,
         # resolved optimizer placement, and measured per-worker resident
         # bytes for every state component
@@ -319,6 +322,7 @@ class TestDriverTelemetry:
         for t in res["round_timings"]:
             assert t["sync_bytes"] > 0
             assert t["sync_ms"] >= 0.0  # the standalone sync program ran
+            assert t["sync_hidden_ms"] == 0.0  # streamed rounds stay sync
         # the streamed path rides the resident layout too (enter program
         # + scatter-exit standalone sync); a replicated layout would
         # report a zero transient gather peak instead
