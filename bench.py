@@ -1001,17 +1001,34 @@ def measure_ckpt() -> dict:
 
 
 def measure_serve() -> dict:
-    """Serving-engine A/B (ISSUE 7): continuous batching vs the naive
-    sequential-request baseline under the SAME Poisson arrival trace.
+    """Serving-engine A/Bs (ISSUE 7 + 17), three arms off one gpt_tiny:
 
-    One gpt_tiny ServeEngine per arm (identical params, pools, compiled
-    programs; the naive arm is the same scheduler capped at max_active=1,
-    so the delta is PURE batching policy).  A fixed-seed Poisson process
-    drives arrivals; each arm reports tokens/s, p50/p99 per-token
-    latency, and the byte-exact page-occupancy accounting (peak_bytes
-    must equal peak pages x the per-page pin across both pools and every
-    layer — recomputed here from first principles).  Acceptance bar
-    (tools/verify.sh): continuous >= 1.5x naive tokens/s on CPU."""
+    1. **batching** — continuous batching vs the naive sequential-request
+       baseline under the SAME Poisson arrival trace (the naive arm is
+       the same scheduler capped at max_active=1, so the delta is PURE
+       batching policy).  Bar: >= 1.2x tokens/s, byte-exact page
+       accounting in both arms.
+    2. **prefix cache** — a shared-system-prompt trace (240-token system
+       prefix + per-request 4-8 token suffixes) served cold vs with
+       ``prefix_cache=True``.  The warm arm prefills only each suffix
+       tail at the [1, 16] bucket (the 30 system pages map in by
+       reference) where the cold arm pays the [1, 256] prefill per
+       request, so the bar is page_reuse_ratio >= 0.5 with tokens/s no
+       worse than cold AND the hit arm's token streams bitwise equal to
+       the cold arm's.
+    3. **chunked prefill** — a mixed long/short Poisson trace (480-token
+       cold prompts landing while short requests decode) served
+       monolithic vs ``prefill_chunk=16``.  Chunking bounds the stall a
+       long admission injects into running decode streams to one chunk
+       per step instead of the whole [1, 512] prefill wall, so the bar
+       is p99 per-DECODE-token latency cut >= 2x with bitwise-identical
+       streams.
+
+    Every arm reports the byte-exact page-occupancy accounting
+    (peak_bytes must equal peak pages x the per-page pin across both
+    pools and every layer — recomputed here from first principles)."""
+    import dataclasses
+
     import jax
     import numpy as np
 
@@ -1031,6 +1048,19 @@ def measure_serve() -> dict:
     prompts = [rng.integers(1, vocab, int(rng.integers(4, 13))).tolist()
                for _ in range(n_req)]
 
+    def account(eng, tele):
+        # independent first-principles re-derivation (dtype-aware, so a
+        # bf16-served model keeps the accounting gate meaningful)
+        spec = eng.spec
+        expected = (2 * spec.num_layers * eng.page_size
+                    * spec.num_kv_heads * spec.head_dim
+                    * np.dtype(spec.dtype).itemsize)
+        pages = tele["pages"]
+        return bool(pages["page_bytes"] == expected
+                    and pages["peak_bytes"]
+                    == pages["peak_in_use"] * expected
+                    and pages["leaked"] == 0)
+
     def one_arm(max_active):
         eng = ServeEngine(model, variables["params"], max_batch=4,
                           page_size=8, max_pages=64, prompt_buckets=(16,),
@@ -1045,13 +1075,6 @@ def measure_serve() -> dict:
             [Request(rid=10_000_000, prompt=prompts[0],
                      max_new_tokens=2)])
         tele = sched.run(reqs)
-        # independent first-principles re-derivation (dtype-aware, so a
-        # bf16-served model keeps the accounting gate meaningful)
-        spec = eng.spec
-        page_bytes_expected = (2 * spec.num_layers * eng.page_size
-                               * spec.num_kv_heads * spec.head_dim
-                               * np.dtype(spec.dtype).itemsize)
-        pages = tele["pages"]
         return {
             "tokens_per_s": tele["tokens_per_s"],
             "wall_s": tele["wall_s"],
@@ -1059,16 +1082,126 @@ def measure_serve() -> dict:
             "tokens": tele["tokens_generated"],
             "latency_ms": tele["latency_ms"],
             "admission_blocked": tele["admission_blocked"],
-            "pages": pages,
-            "page_accounting_exact": bool(
-                pages["page_bytes"] == page_bytes_expected
-                and pages["peak_bytes"]
-                == pages["peak_in_use"] * page_bytes_expected
-                and pages["leaked"] == 0),
+            "pages": tele["pages"],
+            "page_accounting_exact": account(eng, tele),
         }
 
     cont = one_arm(max_active=None)      # full continuous batching
     naive = one_arm(max_active=1)        # sequential-request baseline
+
+    # -- arm 2: hash-and-reuse prefix cache (shared system prompt) ------
+    prng = np.random.default_rng(17)
+    sys_prompt = prng.integers(1, vocab, 240).tolist()    # 30 full pages
+    pc_n = 12
+    pc_prompts = [sys_prompt + prng.integers(
+        1, vocab, int(prng.integers(4, 9))).tolist() for _ in range(pc_n)]
+    pc_arrivals = np.cumsum(prng.exponential(0.002, pc_n))
+
+    def prefix_arm(prefix_cache):
+        eng = ServeEngine(model, variables["params"], max_batch=4,
+                          page_size=8, max_pages=160,
+                          prompt_buckets=(16, 256), max_seq=260, seed=0,
+                          prefix_cache=prefix_cache)
+        # warmup compiles bucket 256 (cold full prompt) + decode, and —
+        # in the warm arm — registers the system prefix and compiles the
+        # bucket-16 tail path, exactly like a server warming its system
+        # prompt at startup
+        ContinuousBatchingScheduler(eng, eos_id=-1).run(
+            [Request(rid=10_000_000, prompt=pc_prompts[0],
+                     max_new_tokens=2),
+             Request(rid=10_000_001, prompt=pc_prompts[1],
+                     max_new_tokens=2)])
+        tele = ContinuousBatchingScheduler(eng, eos_id=-1).run(
+            [Request(rid=i, prompt=pc_prompts[i], max_new_tokens=4,
+                     arrival_s=float(pc_arrivals[i]))
+             for i in range(pc_n)])
+        streams = [c.tokens for c in tele["completions"]]
+        return {
+            "tokens_per_s": tele["tokens_per_s"],
+            "wall_s": tele["wall_s"],
+            "latency_ms": tele["latency_ms"],
+            "ttft_ms": tele["ttft_ms"],
+            "page_reuse_ratio": tele["page_reuse_ratio"],
+            "prefill_tokens_saved": tele["prefill_tokens_saved"],
+            "pages": tele["pages"],
+            "page_accounting_exact": account(eng, tele),
+        }, streams
+
+    pc_cold, pc_cold_streams = prefix_arm(False)
+    pc_warm, pc_warm_streams = prefix_arm(True)
+    prefix_cache = {
+        "requests": pc_n, "sys_tokens": 240,
+        "arrival": "poisson_2ms_seed17",
+        "cold": pc_cold, "warm": pc_warm,
+        "page_reuse_ratio": pc_warm["page_reuse_ratio"],
+        "prefill_tokens_saved": pc_warm["prefill_tokens_saved"],
+        "tokens_per_s_ratio": (round(pc_warm["tokens_per_s"]
+                                     / pc_cold["tokens_per_s"], 2)
+                               if pc_cold["tokens_per_s"] else None),
+        # the gate: a prefix-hit request decodes the IDENTICAL stream
+        # its cold-cache twin does
+        "prefix_hit_bitwise": bool(pc_warm_streams == pc_cold_streams),
+    }
+
+    # -- arm 3: chunked prefill under a mixed long/short trace ----------
+    crng = np.random.default_rng(23)
+    shorts = [(i, crng.integers(1, vocab,
+                                int(crng.integers(4, 9))).tolist(), 20)
+              for i in range(12)]
+    longs = [(100 + i, crng.integers(1, vocab, 480).tolist(), 2)
+             for i in range(3)]
+    short_arr = np.cumsum(crng.exponential(0.002, len(shorts)))
+    cp_reqs = ([Request(rid=r, prompt=p, max_new_tokens=n,
+                        arrival_s=float(short_arr[i]))
+                for i, (r, p, n) in enumerate(shorts)]
+               # long cold prompts land while the shorts are decoding —
+               # spaced so each one's prefill finishes before the next
+               # arrives (the stall measured is ONE long admission's,
+               # not a pile-up of overlapping prefills)
+               + [Request(rid=r, prompt=p, max_new_tokens=n,
+                          arrival_s=0.05 * (i + 1))
+                  for i, (r, p, n) in enumerate(longs)])
+
+    def chunk_arm(prefill_chunk):
+        eng = ServeEngine(model, variables["params"], max_batch=4,
+                          page_size=8, max_pages=96,
+                          prompt_buckets=(8, 512), max_seq=512, seed=0,
+                          prefill_chunk=prefill_chunk)
+        # warmup: both buckets (monolithic) / the one chunk program +
+        # decode (chunked) — a short and a long request cover either set
+        ContinuousBatchingScheduler(eng, eos_id=-1).run(
+            [Request(rid=10_000_000, prompt=shorts[0][1],
+                     max_new_tokens=2),
+             Request(rid=10_000_001, prompt=longs[0][1],
+                     max_new_tokens=2)])
+        tele = ContinuousBatchingScheduler(eng, eos_id=-1).run(
+            [Request(**dataclasses.asdict(r)) for r in cp_reqs])
+        streams = [c.tokens for c in tele["completions"]]
+        return {
+            "tokens_per_s": tele["tokens_per_s"],
+            "wall_s": tele["wall_s"],
+            "latency_ms": tele["latency_ms"],
+            "ttft_ms": tele["ttft_ms"],
+            "prefill_chunks": tele["prefill_chunks"],
+            "pages": tele["pages"],
+            "page_accounting_exact": account(eng, tele),
+        }, streams
+
+    cp_mono, cp_mono_streams = chunk_arm(0)
+    cp_chunk, cp_chunk_streams = chunk_arm(16)
+    mono_p99 = cp_mono["latency_ms"]["p99"]
+    chunk_p99 = cp_chunk["latency_ms"]["p99"]
+    chunked_prefill = {
+        "chunk": 16, "shorts": len(shorts), "longs": len(longs),
+        "long_prompt_tokens": 480,
+        "monolithic": cp_mono, "chunked": cp_chunk,
+        # the headline: the worst-case stall a cold long prompt injects
+        # into RUNNING decode streams, monolithic vs one-chunk-per-step
+        "p99_decode_latency_cut_x": (round(mono_p99 / chunk_p99, 2)
+                                     if chunk_p99 else None),
+        "chunked_bitwise": bool(cp_chunk_streams == cp_mono_streams),
+    }
+
     return {
         "model": "gpt_tiny", "requests": n_req, "max_new_tokens": max_new,
         "arrival": "poisson_5ms_seed0",
@@ -1076,6 +1209,8 @@ def measure_serve() -> dict:
         "speedup_tokens_per_s": (round(cont["tokens_per_s"]
                                        / naive["tokens_per_s"], 2)
                                  if naive["tokens_per_s"] else None),
+        "prefix_cache": prefix_cache,
+        "chunked_prefill": chunked_prefill,
     }
 
 
@@ -2106,6 +2241,15 @@ def _emit_headline(details: dict, extra: dict) -> None:
                      "x": e.get("stall_reduction_x"),
                      "same": 1 if e.get("bitwise_async_eq_blocking")
                      else 0}
+        elif key == "serve_engine":
+            pc = e.get("prefix_cache") or {}
+            cp = e.get("chunked_prefill") or {}
+            d[sk] = {"x": e.get("speedup_tokens_per_s"),
+                     "reuse": pc.get("page_reuse_ratio"),
+                     "rx": pc.get("tokens_per_s_ratio"),
+                     "p99x": cp.get("p99_decode_latency_cut_x"),
+                     "same": 1 if (pc.get("prefix_hit_bitwise")
+                                   and cp.get("chunked_bitwise")) else 0}
         elif key == "elastic_membership":
             d[sk] = {"st": e.get("reshard_stall_ms"),
                      "rd": e.get("steady_round_ms"),
@@ -2241,7 +2385,7 @@ def main() -> None:
         jobs[at:at] = ([("round_gap", 150), ("sync_collectives", 120),
                         ("gossip_collectives", 120), ("hier_sync", 120),
                         ("compile_engine", 150), ("memory_tier", 150),
-                        ("ckpt_engine", 120), ("serve_engine", 120),
+                        ("ckpt_engine", 120), ("serve_engine", 180),
                         ("elastic_membership", 150),
                         ("crash_recovery", 180),
                         ("sim_lab", 150),

@@ -347,6 +347,26 @@ class Config:
     # "timeout", counted in results["serve"]["timed_out"]) so a stuck
     # request can never pin decode slots and cache pages forever.
     serve_request_timeout: float = 0.0
+    # --- serving fast path (ISSUE 17) --------------------------------------
+    # Paged prefix cache: pages become content-addressed (a page's key is
+    # the rolling hash of the token prefix it closes) with a refcounted
+    # hash -> physical-page index.  Admission walks the prompt's
+    # page-aligned prefix and maps every cached page straight into the
+    # new sequence's page table BY REFERENCE (never copied — the
+    # cache-offset causal mask makes shared pages position-safe),
+    # prefilling only the cold tail, so N requests sharing a system
+    # prompt pay its KV once.  Eviction moves from the free-list head to
+    # refcount-0 LRU; results["serve"] gains page_reuse_ratio +
+    # prefill_tokens_saved.
+    serve_prefix_cache: bool = False
+    # Chunked prefill: > 0 replaces the per-bucket monolithic prefill
+    # with ONE fixed-shape [1, C] chunk program interleaved into the
+    # decode loop — a long cold prompt advances C tokens per scheduler
+    # tick instead of stalling every running stream, and the compiled
+    # prefill set shrinks from one-per-bucket to exactly one.  Must be a
+    # positive multiple of --serve_page_size (chunk boundaries must land
+    # on page boundaries); 0 = the monolithic per-bucket path.
+    serve_prefill_chunk: int = 0
     # --- scenario lab: vmap'd many-worker simulator (ISSUE 14) -------------
     # sim_workers: > 0 runs the ENTIRE local-SGD round for that many
     # workers as one vmap'd, donated jit on a SINGLE chip — per-worker
@@ -574,7 +594,33 @@ class Config:
             raise ValueError(
                 f"serve_request_timeout must be >= 0 (0 = off), got "
                 f"{self.serve_request_timeout}")
-        self.parse_prompt_buckets()   # validates the csv eagerly
+        if self.serve_prefill_chunk < 0 or (
+                self.serve_prefill_chunk
+                and self.serve_prefill_chunk % self.serve_page_size):
+            raise ValueError(
+                f"--serve_prefill_chunk must be a positive multiple of "
+                f"--serve_page_size ({self.serve_page_size}) — chunk "
+                f"boundaries must land on page boundaries so every chunk "
+                f"writes whole pages (and the prefix cache can key them) "
+                f"— got {self.serve_prefill_chunk}; 0 disables chunking")
+        buckets = self.parse_prompt_buckets()   # validates the csv eagerly
+        if self.serve_prefix_cache:
+            # the serve engine sizes sequences at max_seq = largest
+            # bucket + serve_max_new_tokens; if ONE such sequence can pin
+            # the whole pool there is never a refcount-0 page to retain,
+            # so the cache could only ever thrash — reject eagerly
+            longest = buckets[-1] + self.serve_max_new_tokens
+            seq_pages = -(-longest // self.serve_page_size)
+            if seq_pages >= self.serve_max_pages - 1:
+                raise ValueError(
+                    f"--serve_prefix_cache needs page-pool headroom "
+                    f"beyond one max-length sequence: a {longest}-token "
+                    f"sequence (largest bucket {buckets[-1]} + "
+                    f"serve_max_new_tokens {self.serve_max_new_tokens}) "
+                    f"pins {seq_pages} of the {self.serve_max_pages - 1} "
+                    f"usable pages (page 0 is the trash page), so no "
+                    f"page could ever stay cached — raise "
+                    f"--serve_max_pages past {seq_pages + 1}")
         if self.chaos and self.chaos.strip().lower() != "random":
             # eager spec validation, like parse_prompt_buckets: a typo'd
             # --chaos fails at argparse time, not at round boundary 3
@@ -1401,6 +1447,19 @@ def build_argparser() -> argparse.ArgumentParser:
                         "— a sequence still decoding past it is evicted "
                         "(reason 'timeout') instead of pinning its slot "
                         "and pages forever (0 = off)")
+    p.add_argument("--serve_prefix_cache", action="store_true",
+                   default=d.serve_prefix_cache,
+                   help="serve: content-address the KV pages (rolling "
+                        "hash of the prefix each page closes) and map "
+                        "cached pages into new sequences by reference — "
+                        "shared prompt prefixes prefill once; eviction "
+                        "becomes refcount-0 LRU")
+    p.add_argument("--serve_prefill_chunk", type=int,
+                   default=d.serve_prefill_chunk,
+                   help="serve: prefill in fixed [1, C] chunks "
+                        "interleaved with decode steps instead of one "
+                        "monolithic per-bucket program (positive "
+                        "multiple of --serve_page_size; 0 = monolithic)")
     # --- chaos / elastic membership group (ISSUE 8) ------------------------
     p.add_argument("--chaos", type=str, default=d.chaos,
                    help="fault-injection plan: comma-separated "

@@ -7,9 +7,11 @@ Four modules, host policy separated from device programs:
   prefill + one fixed-batch decode step) over the paged KV cache, and
   ``from_checkpoint``: direct-to-device loading of the PR 5 sharded
   layout (worker-0 params row, leaf-streamed, no host full-gather).
-- ``cache``     — host-side page bookkeeping: free-list ``PageAllocator``
-  (page 0 reserved as the trash page), page-table rows, byte-exact
-  occupancy accounting.
+- ``cache``     — host-side page bookkeeping: refcounted, content-
+  addressed ``PageAllocator`` (page 0 reserved as the trash page;
+  ``page_prefix_keys`` rolling hashes key shared prompt-prefix pages,
+  refcount-0 keyed pages park on an LRU instead of the free list),
+  page-table rows, byte-exact occupancy accounting.
 - ``scheduler`` — ``ContinuousBatchingScheduler``: admit/evict per decode
   step, all-or-nothing page claims, EOS/budget stops, telemetry.
 - ``api``       — the driver surface: ``main.py serve`` / ``run_serve``
@@ -20,9 +22,11 @@ slot/batch-independent sampling keys) lives in ``models/decode.py`` next
 to the training forwards it mirrors.
 """
 
-from .cache import PageAllocator, page_table_row, pages_needed
+from .cache import (PageAllocator, page_prefix_keys, page_table_row,
+                    pages_needed)
 from .engine import ServeEngine
 from .scheduler import Completion, ContinuousBatchingScheduler, Request
 
 __all__ = ["ServeEngine", "ContinuousBatchingScheduler", "Request",
-           "Completion", "PageAllocator", "page_table_row", "pages_needed"]
+           "Completion", "PageAllocator", "page_prefix_keys",
+           "page_table_row", "pages_needed"]

@@ -6,10 +6,13 @@ and the ``--serve_*`` group; restating ``--model`` is optional and
 cross-checked (mismatch is a hard error, not a silent override).
 
 ``--sanitize`` arms the serving twin of the round-loop retrace budget:
-after a one-request warmup has compiled the prefill buckets + decode
-step, the measured run must add ZERO jaxpr traces / backend compiles —
-the continuous-batching loop re-dispatches two fixed programs, nothing
-else.
+after a warmup has compiled the workload's programs (every prefill
+bucket — all configured buckets under ``--serve_prefix_cache``, since a
+partial hit prefills its tail at a smaller bucket — or the single
+``[1, C]`` chunk program under ``--serve_prefill_chunk``, plus the
+decode step), the measured run must add ZERO jaxpr traces / backend
+compiles — the continuous-batching loop re-dispatches fixed programs,
+nothing else.
 """
 
 from __future__ import annotations
@@ -116,7 +119,8 @@ def run_serve(cfg, requests: Optional[list] = None, *,
         page_size=cfg.serve_page_size, max_pages=cfg.serve_max_pages,
         prompt_buckets=buckets,
         max_seq=buckets[-1] + cfg.serve_max_new_tokens,
-        seed=cfg.seed)
+        seed=cfg.seed, prefix_cache=cfg.serve_prefix_cache,
+        prefill_chunk=cfg.serve_prefill_chunk)
     if requests is None:
         requests = build_requests(cfg, engine.spec.vocab)
 
@@ -130,22 +134,51 @@ def run_serve(cfg, requests: Optional[list] = None, *,
                                  install_compile_counter)
         counter_ok = install_compile_counter()
         if counter_ok:
-            # warmup: ONE request per distinct prefill bucket compiles
-            # every program the workload uses (+ the shared decode step)
-            # off the measured run — warming all N requests would scale
-            # startup with N for no extra compile coverage
             from ..utils.batching import pick_bucket
             from .scheduler import Request
-            per_bucket = {}
-            for r in requests:
-                per_bucket.setdefault(
-                    pick_bucket(len(r.prompt), engine.prompt_buckets), r)
-            warm = [Request(rid=10_000_000 + i, prompt=r.prompt,
-                            max_new_tokens=min(2, r.max_new_tokens),
-                            temperature=r.temperature)
-                    for i, r in enumerate(per_bucket.values())]
-            ContinuousBatchingScheduler(
-                engine, eos_id=cfg.serve_eos_id).run(warm)
+            mnt = min(2, cfg.serve_max_new_tokens)
+            if engine.prefill_chunk:
+                # chunked prefill: ONE [1, C] chunk program covers every
+                # prompt length — a single longest-prompt request (>= 2
+                # chunks when possible) compiles it + the decode step
+                r0 = max(requests, key=lambda r: len(r.prompt),
+                         default=None)
+                warm = ([Request(rid=10_000_000, prompt=r0.prompt,
+                                 max_new_tokens=mnt,
+                                 temperature=r0.temperature)]
+                        if r0 is not None else [])
+            else:
+                # warmup: ONE request per distinct prefill bucket
+                # compiles every program the workload uses (+ the shared
+                # decode step) off the measured run — warming all N
+                # requests would scale startup with N for no extra
+                # compile coverage.  With the prefix cache on, a
+                # measured request can HIT pages and prefill only its
+                # tail at a SMALLER bucket than its full length picks —
+                # cover every configured bucket, not just the full-
+                # length ones, so a partial hit can never retrace.
+                per_bucket = {}
+                for r in requests:
+                    per_bucket.setdefault(
+                        pick_bucket(len(r.prompt), engine.prompt_buckets),
+                        r)
+                warm = [Request(rid=10_000_000 + i, prompt=r.prompt,
+                                max_new_tokens=min(2, r.max_new_tokens),
+                                temperature=r.temperature)
+                        for i, r in enumerate(per_bucket.values())]
+                if engine.prefix_cache:
+                    rng = np.random.default_rng(cfg.seed)
+                    warm += [
+                        Request(rid=11_000_000 + i,
+                                prompt=rng.integers(
+                                    0, engine.spec.vocab, b).tolist(),
+                                max_new_tokens=mnt,
+                                temperature=cfg.serve_temperature)
+                        for i, b in enumerate(engine.prompt_buckets)
+                        if b not in per_bucket]
+            if warm:
+                ContinuousBatchingScheduler(
+                    engine, eos_id=cfg.serve_eos_id).run(warm)
             warmup_counts = compile_event_counts()
 
     sched = ContinuousBatchingScheduler(

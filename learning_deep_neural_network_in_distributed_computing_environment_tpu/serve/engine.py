@@ -5,11 +5,19 @@ Exactly TWO program shapes exist per served model (the compile-counter
 gate in tests/test_serve.py):
 
 - **prefill** — one program per prompt-length bucket, ``[1, bucket]``
-  tokens at cache offset 0.  Prompts pad up to the smallest covering
-  bucket (``utils.batching``); the padding rows write to the trash page
-  and the returned logits are taken at the last REAL position.
+  tokens at a dynamic cache offset (0 for a cold prompt; the hit length
+  when a prefix-cache hit leaves only the cold tail to fill).  Prompts
+  pad up to the smallest covering bucket (``utils.batching``); the
+  padding rows write to the trash page and the returned logits are taken
+  at the last REAL position.
 - **decode** — ONE program at the fixed ``[max_batch]`` slot shape,
   advancing every active slot a single token per call.
+
+With ``prefill_chunk=C`` (PR 17) the per-bucket prefill programs are
+replaced wholesale by ONE fixed ``[1, C]`` chunk program — the same
+prefill math, called repeatedly at successive cache offsets, so a single
+executable covers every prompt length and the compiled-program set
+shrinks from one-per-bucket to exactly two.
 
 Both donate the cache buffers (the pools are the big arrays; a decode
 step must not double them) and both end in ``models.decode.sample_tokens``
@@ -68,16 +76,24 @@ def _build_decode_program(spec: D.DecodeSpec, seed: int):
 
 
 def _build_prefill_program(spec: D.DecodeSpec, seed: int):
-    def prefill_step(params, kc, vc, tokens, prompt_len, page_row, temp,
-                     rid):
-        lengths = jnp.zeros((1,), jnp.int32)
+    """Prefill ``num_valid`` tokens starting at cache position ``offset``.
+
+    ``offset=0, num_valid=plen`` is the classic one-shot bucket prefill;
+    a prefix-cache hit runs the same program over just the cold tail
+    (``offset = hit tokens``), and the chunked path calls it at the fixed
+    ``[1, C]`` shape once per chunk.  The sampled token is drawn at the
+    absolute position ``offset + num_valid`` — for the final (or only)
+    span of a prompt that is exactly the first generated position, so
+    every path seeds sampling identically."""
+    def prefill_step(params, kc, vc, tokens, num_valid, offset, page_row,
+                     temp, rid):
         logits, kc, vc = D.forward_paged(
-            spec, params, tokens, lengths, prompt_len[None],
+            spec, params, tokens, offset[None], num_valid[None],
             page_row[None], kc, vc)
         last = jnp.take_along_axis(
-            logits[0], (prompt_len - 1)[None, None], axis=0)[0]
+            logits[0], (num_valid - 1)[None, None], axis=0)[0]
         nxt = D.sample_tokens(last[None], temp[None], rid[None],
-                              prompt_len[None], seed)
+                              (offset + num_valid)[None], seed)
         return nxt[0], last, kc, vc
 
     return jax.jit(prefill_step, donate_argnums=(1, 2))
@@ -313,7 +329,8 @@ class ServeEngine:
     def __init__(self, model, params, *, max_batch: int = 4,
                  page_size: int = 16, max_pages: int = 64,
                  prompt_buckets=(16, 64), max_seq: Optional[int] = None,
-                 mesh=None, seed: int = 0):
+                 mesh=None, seed: int = 0, prefix_cache: bool = False,
+                 prefill_chunk: int = 0):
         self.spec = D.spec_from_model(model)
         self.model = model
         if page_size < 1 or max_batch < 1:
@@ -338,6 +355,22 @@ class ServeEngine:
                 f"max_seq {self.max_seq} exceeds the model's position "
                 f"table ({self.spec.max_len})")
         self.pages_per_seq = pages_needed(self.max_seq, self.page_size)
+        self.prefill_chunk = int(prefill_chunk)
+        if self.prefill_chunk < 0 or (self.prefill_chunk
+                                      and self.prefill_chunk
+                                      % self.page_size):
+            raise ValueError(
+                f"prefill_chunk must be a positive multiple of page_size "
+                f"({self.page_size}) so chunk boundaries land on page "
+                f"boundaries, got {self.prefill_chunk}")
+        self.prefix_cache = bool(prefix_cache)
+        if self.prefix_cache and self.pages_per_seq >= max_pages - 1:
+            raise ValueError(
+                f"prefix_cache needs page-pool headroom beyond one "
+                f"max-length sequence: a {self.max_seq}-token sequence "
+                f"pins {self.pages_per_seq} of the {max_pages - 1} usable "
+                f"pages (page 0 is the trash page), so nothing could ever "
+                f"stay cached — raise max_pages")
         self.allocator = PageAllocator(max_pages)
         self.seed = int(seed)
         self._sharding = None
@@ -365,13 +398,28 @@ class ServeEngine:
         self._prefill = TrackedProgram(
             "prefill", _build_prefill_program(self.spec, self.seed),
             multi_shape=True)
+        # the chunk program is the SAME prefill math pinned to one
+        # [1, prefill_chunk] shape — its own jit instance in single-shape
+        # mode, so the per-chunk hot path pays zero shape bookkeeping
+        self._chunk = (TrackedProgram(
+            "prefill_chunk", _build_prefill_program(self.spec, self.seed))
+            if self.prefill_chunk else None)
         self.compiled_buckets: list[int] = []
 
     def memory_programs(self) -> dict:
         """Label -> TrackedProgram registry (the serve twin of
         ``LocalSGDEngine.memory_programs``): the fixed-batch decode step
-        plus one prefill executable per compiled prompt bucket."""
-        return {"decode_step": self._decode, "prefill": self._prefill}
+        plus one prefill executable per compiled prompt bucket — or, when
+        chunked prefill is on, the single fixed-shape chunk program."""
+        out = {"decode_step": self._decode, "prefill": self._prefill}
+        if self._chunk is not None:
+            out["prefill_chunk"] = self._chunk
+            if not self.compiled_buckets:
+                # chunking replaced bucket prefill entirely this run —
+                # an uncompiled bucket program is absence, not an AOT
+                # fallback, so don't let it flip ``available`` off
+                del out["prefill"]
+        return out
 
     # -- construction from a sharded checkpoint ------------------------
     @classmethod
@@ -437,12 +485,15 @@ class ServeEngine:
 
     # -- the two programs ----------------------------------------------
     def prefill(self, prompt, page_row: np.ndarray, temperature: float,
-                rid: int) -> tuple[int, jax.Array]:
+                rid: int, *, offset: int = 0) -> tuple[int, jax.Array]:
         """Run one prompt through the prefill program at its bucket
         shape, filling the sequence's pages; returns (first sampled
-        token, last-position logits).  The logits stay a DEVICE array —
-        the hot admission path only needs the sampled token, so the
-        [vocab] fetch is paid only by callers that read them."""
+        token, last-position logits).  ``offset`` is the cache position
+        the span starts at — 0 for a cold prompt, the hit length when a
+        prefix-cache hit leaves only the cold tail (the bucket then
+        covers just the tail).  The logits stay a DEVICE array — the hot
+        admission path only needs the sampled token, so the [vocab]
+        fetch is paid only by callers that read them."""
         prompt = np.asarray(prompt, np.int32)
         plen = int(prompt.shape[0])
         bucket = pick_bucket(plen, self.prompt_buckets)
@@ -451,7 +502,34 @@ class ServeEngine:
         padded = pad_to_bucket(prompt, bucket)[None]
         nxt, last, self.kcache, self.vcache = self._prefill(
             self.params, self.kcache, self.vcache, jnp.asarray(padded),
-            jnp.asarray(plen, jnp.int32), jnp.asarray(page_row),
+            jnp.asarray(plen, jnp.int32), jnp.asarray(offset, jnp.int32),
+            jnp.asarray(page_row),
+            jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(rid, jnp.int32))
+        return int(nxt), last
+
+    def prefill_chunk_step(self, chunk, offset: int,
+                           page_row: np.ndarray, temperature: float,
+                           rid: int) -> tuple[int, jax.Array]:
+        """Advance one prompt by ONE ``[1, prefill_chunk]`` chunk at
+        cache position ``offset``; returns (sampled token, logits at the
+        chunk's last valid position).  Intermediate chunks' samples are
+        discarded by the scheduler; the FINAL chunk's sample is drawn at
+        ``offset + num_valid == prompt_len`` — bit-for-bit the position
+        the monolithic prefill samples at."""
+        if self._chunk is None:
+            raise RuntimeError("engine built without prefill_chunk")
+        chunk = np.asarray(chunk, np.int32)
+        nvalid = int(chunk.shape[0])
+        if not 0 < nvalid <= self.prefill_chunk:
+            raise ValueError(
+                f"chunk of {nvalid} tokens outside (0, "
+                f"{self.prefill_chunk}]")
+        padded = pad_to_bucket(chunk, self.prefill_chunk)[None]
+        nxt, last, self.kcache, self.vcache = self._chunk(
+            self.params, self.kcache, self.vcache, jnp.asarray(padded),
+            jnp.asarray(nvalid, jnp.int32), jnp.asarray(offset, jnp.int32),
+            jnp.asarray(page_row),
             jnp.asarray(temperature, jnp.float32),
             jnp.asarray(rid, jnp.int32))
         return int(nxt), last

@@ -4,21 +4,37 @@ Every loop iteration is one decode step of the whole engine batch:
 
 1. **admit** — arrived requests claim free decode slots in order; each
    gets its WHOLE page span (``ceil((prompt + max_new) / page_size)``
-   pages) up front, runs its bucket's prefill program, and samples its
-   first token.  When the pool or the slots are exhausted the head
-   request waits (``admission_blocked`` counts the backpressure) — a
-   running decode can never die from page exhaustion.
-2. **decode** — ONE call of the fixed-shape decode program advances every
-   active slot a token; free slots ride along masked (their writes go to
-   the trash page).
-3. **evict** — slots whose new token is ``eos_id`` or whose budget is
-   spent return their pages to the allocator head (the recycle the tests
-   assert) and free the slot for the next admission.
+   pages) up front.  With the prefix cache on, the prompt's page-aligned
+   prefix is hashed first and every cached page maps straight into the
+   new sequence's page table by reference (claimed, never copied) — only
+   the cold tail is prefilled.  When the pool or the slots are exhausted
+   the head request waits (``admission_blocked`` counts the
+   backpressure) — a running decode can never die from page exhaustion.
+2. **chunked prefill** (``engine.prefill_chunk > 0``) — every slot still
+   filling its prompt advances ONE ``[1, C]`` chunk, so a long cold
+   prompt costs the running decode streams at most one chunk of latency
+   per step instead of its whole prefill wall.  The final chunk's sample
+   is the slot's first token, drawn at the same absolute position the
+   monolithic prefill samples at.  With chunking off, admission prefills
+   the whole prompt inline exactly as before.
+3. **decode** — ONE call of the fixed-shape decode program advances every
+   decoding slot a token; free and still-prefilling slots ride along
+   masked (their writes go to the trash page).
+4. **evict** — slots whose new token is ``eos_id`` or whose budget is
+   spent release their page references (an unshared page returns to the
+   allocator head — the recycle the tests assert; a shared or cached
+   page survives) and free the slot for the next admission.
 
 Sampling keys derive from (seed, request id, position) only — slot and
 batch-composition independent — so a request decodes the identical token
 stream whether it ran alone or packed with others (the
-batched-vs-single gate).
+batched-vs-single gate), and a prefix-cache hit decodes the identical
+stream as its cold-cache twin (the PR 17 gate).
+
+Latency telemetry splits per request into TTFT (admission → first
+token — covers prefill, however it is scheduled) and per-DECODE-token
+gaps; both distributions zero-fill to 0.0 on empty runs, like
+``sync_ms``.
 """
 
 from __future__ import annotations
@@ -30,6 +46,7 @@ from typing import Optional
 
 import numpy as np
 
+from .cache import page_prefix_keys
 from .engine import ServeEngine
 
 
@@ -47,8 +64,9 @@ class Completion:
     rid: int
     prompt_len: int
     tokens: list                  # generated ids (incl. the eos, if hit)
-    reason: str                   # "eos" | "length"
-    token_latencies_s: list       # arrival->first, then inter-token gaps
+    reason: str                   # "eos" | "length" | "timeout"
+    ttft_s: Optional[float]       # admission -> first token (None: none)
+    decode_latencies_s: list      # inter-token gaps, first token excluded
 
 
 @dataclasses.dataclass
@@ -56,13 +74,23 @@ class _Slot:
     rid: int
     pages: list
     row: np.ndarray               # page-table row [pages_per_seq]
-    length: int                   # tokens in cache
+    prompt: np.ndarray            # the full prompt (chunked refill source)
+    plen: int
+    filled: int                   # prompt tokens already in the cache
+    length: int                   # decode-visible tokens in cache
     temperature: float
     max_new: int
     generated: list
-    latencies: list
+    decode_lat: list
+    keys: list                    # content keys of the full prompt pages
+    registered: int               # prefix pages already published
     t_last: float
     t_admit: float = 0.0          # wall clock at admission (timeout base)
+    ttft_s: Optional[float] = None
+
+    @property
+    def prefilling(self) -> bool:
+        return self.filled < self.plen
 
 
 class ContinuousBatchingScheduler:
@@ -87,7 +115,9 @@ class ContinuousBatchingScheduler:
                 f"request_timeout must be >= 0, got {request_timeout}")
         self.stats = {"admitted": 0, "evicted": 0, "admission_blocked": 0,
                       "decode_steps": 0, "tokens_generated": 0,
-                      "timed_out": 0}
+                      "timed_out": 0, "prefill_chunks": 0,
+                      "prefix_hit_pages": 0, "prefix_prompt_pages": 0,
+                      "prefill_tokens_saved": 0}
         self._occupancy: list[int] = []
 
     # -- request validation (fail at submit, not mid-run) ---------------
@@ -105,7 +135,10 @@ class ContinuousBatchingScheduler:
                 f"request {r.rid}: prompt ids must lie in "
                 f"[0, {eng.spec.vocab}); got range "
                 f"[{int(ids.min())}, {int(ids.max())}]")
-        if plen > eng.prompt_buckets[-1]:
+        if not eng.prefill_chunk and plen > eng.prompt_buckets[-1]:
+            # the chunk program covers any length; the bucket bound only
+            # applies to the monolithic per-bucket prefill (a prefix-hit
+            # tail always fits a bucket the full prompt fits)
             raise ValueError(
                 f"request {r.rid}: prompt length {plen} exceeds the "
                 f"largest prefill bucket {eng.prompt_buckets[-1]}")
@@ -128,34 +161,97 @@ class ContinuousBatchingScheduler:
         if (free_slot is None
                 or sum(s is not None for s in slots) >= self.max_active):
             return False
-        pages = eng.allocator.alloc(
-            eng.pages_for(len(r.prompt) + r.max_new_tokens))
-        if pages is None:
+        plen = len(r.prompt)
+        keys: list = []
+        hits: list = []
+        if eng.prefix_cache:
+            keys = page_prefix_keys(r.prompt, eng.page_size)
+            # never reuse past (plen - 1): the tail prefill must keep at
+            # least one real token so it produces the first-token logits
+            hits = eng.allocator.lookup(keys[:(plen - 1) // eng.page_size])
+        # claim the hits BEFORE the fresh alloc: alloc may evict
+        # refcount-0 cached pages to cover a shortfall, and a claimed
+        # page can never be on that LRU
+        for p in hits:
+            eng.allocator.claim(p)
+        fresh = eng.allocator.alloc(
+            eng.pages_for(plen + r.max_new_tokens) - len(hits))
+        if fresh is None:
+            if hits:
+                eng.allocator.free(hits)
             self.stats["admission_blocked"] += 1
             return False
+        pages = hits + fresh
         row = eng.table_row(pages)
-        first, _ = eng.prefill(r.prompt, row, r.temperature, r.rid)
-        now = time.perf_counter()
+        hit_tok = len(hits) * eng.page_size
+        if eng.prefix_cache:
+            self.stats["prefix_hit_pages"] += len(hits)
+            self.stats["prefix_prompt_pages"] += eng.pages_for(plen)
+            self.stats["prefill_tokens_saved"] += hit_tok
+        t_adm = time.perf_counter()
         slot = _Slot(rid=r.rid, pages=pages, row=row,
-                     length=len(r.prompt), temperature=r.temperature,
-                     max_new=r.max_new_tokens, generated=[first],
-                     latencies=[now - (t0 + r.arrival_s)], t_last=now,
-                     t_admit=now)
+                     prompt=np.asarray(r.prompt, np.int32), plen=plen,
+                     filled=hit_tok, length=plen,
+                     temperature=r.temperature, max_new=r.max_new_tokens,
+                     generated=[], decode_lat=[], keys=keys,
+                     registered=len(hits), t_last=t_adm, t_admit=t_adm)
+        if not eng.prefill_chunk:
+            first, _ = eng.prefill(slot.prompt[hit_tok:], row,
+                                   r.temperature, r.rid, offset=hit_tok)
+            now = time.perf_counter()
+            slot.generated = [first]
+            slot.filled = plen
+            slot.ttft_s = now - t_adm
+            slot.t_last = now
+            self.stats["tokens_generated"] += 1
+            self._register_prefix(slot)
         slots[free_slot] = slot
         self.stats["admitted"] += 1
-        self.stats["tokens_generated"] += 1
         self._occupancy.append(eng.allocator.in_use)
         return True
+
+    def _register_prefix(self, slot: _Slot) -> None:
+        """Publish the content keys of every FULL prompt page the slot
+        has finished writing (hit pages arrive pre-registered); the
+        partial last page and all decode pages stay private — this
+        sequence keeps writing into them."""
+        if not self.engine.prefix_cache or not slot.keys:
+            return
+        nfull = min(slot.filled // self.engine.page_size, len(slot.keys))
+        for i in range(slot.registered, nfull):
+            self.engine.allocator.register(slot.keys[i], slot.pages[i])
+        slot.registered = max(slot.registered, nfull)
+
+    def _advance_chunk(self, slot: _Slot) -> None:
+        """One ``[1, C]`` chunk of this slot's prompt into the cache; the
+        final chunk's sample becomes the slot's first generated token."""
+        eng = self.engine
+        start = slot.filled
+        end = min(start + eng.prefill_chunk, slot.plen)
+        tok, _ = eng.prefill_chunk_step(slot.prompt[start:end], start,
+                                        slot.row, slot.temperature,
+                                        slot.rid)
+        slot.filled = end
+        self.stats["prefill_chunks"] += 1
+        self._register_prefix(slot)
+        if end >= slot.plen:
+            now = time.perf_counter()
+            slot.generated = [tok]
+            slot.ttft_s = now - slot.t_admit
+            slot.t_last = now
+            self.stats["tokens_generated"] += 1
 
     def _finish(self, slot: _Slot, reason: str) -> Completion:
         self.engine.allocator.free(slot.pages)
         self.stats["evicted"] += 1
-        return Completion(rid=slot.rid,
-                          prompt_len=slot.length - len(slot.generated) + 1,
+        return Completion(rid=slot.rid, prompt_len=slot.plen,
                           tokens=slot.generated, reason=reason,
-                          token_latencies_s=slot.latencies)
+                          ttft_s=slot.ttft_s,
+                          decode_latencies_s=slot.decode_lat)
 
     def _stop_reason(self, slot: _Slot) -> Optional[str]:
+        if not slot.generated:
+            return None
         if self.eos_id >= 0 and slot.generated[-1] == self.eos_id:
             return "eos"
         if len(slot.generated) >= slot.max_new:
@@ -205,9 +301,20 @@ class ContinuousBatchingScheduler:
                 if reason:   # eos on the very first token / max_new == 1
                     done[slot.rid] = self._finish(slot, reason)
                     slots[slots.index(slot)] = None
-            active_idx = [i for i, s in enumerate(slots) if s is not None]
+            # chunked prefill: every filling slot advances one chunk per
+            # iteration, interleaved with the decode step below
+            for i, s in enumerate(slots):
+                if s is None or not s.prefilling:
+                    continue
+                self._advance_chunk(s)
+                reason = self._stop_reason(s)
+                if reason:   # first token was eos / max_new == 1
+                    done[s.rid] = self._finish(s, reason)
+                    slots[i] = None
+            active_idx = [i for i, s in enumerate(slots)
+                          if s is not None and not s.prefilling]
             if not active_idx:
-                if queue:
+                if queue and not any(s is not None for s in slots):
                     # waiting on a future arrival (pages/slots cannot be
                     # the blocker with nothing active — the pool is empty)
                     time.sleep(max(0.0, min(
@@ -236,7 +343,7 @@ class ContinuousBatchingScheduler:
                 s = slots[i]
                 s.length += 1
                 s.generated.append(int(nxt[i]))
-                s.latencies.append(t_now - s.t_last)
+                s.decode_lat.append(t_now - s.t_last)
                 s.t_last = t_now
                 self.stats["tokens_generated"] += 1
                 reason = self._stop_reason(s)
@@ -250,17 +357,28 @@ class ContinuousBatchingScheduler:
     # -- telemetry -------------------------------------------------------
     def _telemetry(self, requests, done: dict, wall: float) -> dict:
         eng = self.engine
-        lat = [l for c in done.values() for l in c.token_latencies_s]
-        lat_ms = sorted(1e3 * x for x in lat)
+        dec_ms = sorted(1e3 * x for c in done.values()
+                        for x in c.decode_latencies_s)
+        ttft_ms = sorted(1e3 * c.ttft_s for c in done.values()
+                         if c.ttft_s is not None)
 
-        def pct(p):
-            if not lat_ms:
-                return None
-            return round(lat_ms[min(len(lat_ms) - 1,
-                                    int(p / 100.0 * len(lat_ms)))], 3)
+        def dist(samples_ms):
+            # zero-filled schema on empty runs (the sync_ms convention):
+            # consumers always see the same keys with float values
+            def pct(p):
+                if not samples_ms:
+                    return 0.0
+                return round(samples_ms[min(len(samples_ms) - 1,
+                                            int(p / 100.0
+                                                * len(samples_ms)))], 3)
+            return {"p50": pct(50), "p99": pct(99),
+                    "mean": (round(float(np.mean(samples_ms)), 3)
+                             if samples_ms else 0.0)}
 
         occ = self._occupancy or [0]
         page_bytes = eng.page_bytes()
+        hit_pages = self.stats["prefix_hit_pages"]
+        prompt_pages = self.stats["prefix_prompt_pages"]
         out = {
             "enabled": True,
             "requests": len(requests),
@@ -274,10 +392,16 @@ class ContinuousBatchingScheduler:
             "tokens_per_s": round(
                 self.stats["tokens_generated"] / max(wall, 1e-9), 2),
             "prefill_buckets": sorted(eng.compiled_buckets),
+            "prefill_chunks": self.stats["prefill_chunks"],
             "max_batch": eng.max_batch,
-            "latency_ms": {"p50": pct(50), "p99": pct(99),
-                           "mean": (round(float(np.mean(lat_ms)), 3)
-                                    if lat_ms else None)},
+            # per-DECODE-token gaps only; the first token's wall (which
+            # includes prefill) lives in ttft_ms — inline prefill no
+            # longer pollutes the per-token percentiles
+            "latency_ms": dist(dec_ms),
+            "ttft_ms": dist(ttft_ms),
+            "page_reuse_ratio": (round(hit_pages / prompt_pages, 4)
+                                 if prompt_pages else 0.0),
+            "prefill_tokens_saved": self.stats["prefill_tokens_saved"],
             # byte-exact page accounting: in_use sampled after every
             # admission/step x the per-page pin across both pools
             "pages": {"page_size": eng.page_size,
@@ -286,6 +410,8 @@ class ContinuousBatchingScheduler:
                       "peak_in_use": max(occ),
                       "mean_in_use": round(float(np.mean(occ)), 2),
                       "peak_bytes": max(occ) * page_bytes,
+                      "cached_pages": eng.allocator.cached_pages,
+                      "cache_evictions": eng.allocator.cache_evictions,
                       "leaked": eng.allocator.in_use},
         }
         out["completions"] = [done[r.rid] for r in requests

@@ -511,6 +511,39 @@ def overlapped_rounds(round_prog, stale_sync, deliver, state, batch):
 """
         assert "R4" not in rules_for(src)
 
+    def test_chunked_prefill_pool_use_after_donate_flagged(self):
+        # ISSUE 17 fixture: the [1, C] chunk program donates BOTH page
+        # pools every call (engine._build_prefill_program
+        # donate_argnums=(1, 2)) and the scheduler calls it once per
+        # chunk — reading the pre-chunk kc/vc between chunks touches the
+        # freed generation of the dominant serve allocation, the exact
+        # hazard class R4 exists for
+        src = """
+import jax
+def prefill_loop(chunk_step, params, kc, vc, chunk, tail):
+    prog = jax.jit(chunk_step, donate_argnums=(1, 2))
+    tok, logits, kc2, vc2 = prog(params, kc, vc, chunk)
+    warm = kc  # donated page pool read between chunks
+    tok, logits, kc2, vc2 = prog(params, kc2, vc2, tail)
+    return tok, warm
+"""
+        assert "R4" in rules_for(src)
+
+    def test_chunked_prefill_pool_rebound_each_chunk_clean(self):
+        # the engine's real shape: every chunk rebinds the pool names to
+        # the returned pools in the same statement, so the next chunk
+        # (and the interleaved decode step) only ever sees the current
+        # generation
+        src = """
+import jax
+def prefill_loop(chunk_step, params, kc, vc, chunks):
+    prog = jax.jit(chunk_step, donate_argnums=(1, 2))
+    for c in chunks:
+        tok, logits, kc, vc = prog(params, kc, vc, c)
+    return tok, kc, vc
+"""
+        assert "R4" not in rules_for(src)
+
     def test_rebound_name_no_longer_shard_map_clean(self):
         src = """
 import jax

@@ -20,6 +20,7 @@ Correctness gates, tier-1 style:
   self-configures the model.
 """
 
+import dataclasses
 import os
 import sys
 
@@ -46,6 +47,7 @@ from learning_deep_neural_network_in_distributed_computing_environment_tpu.serve
     PageAllocator,
     Request,
     ServeEngine,
+    page_prefix_keys,
 )
 from learning_deep_neural_network_in_distributed_computing_environment_tpu.utils.batching import (  # noqa: E402
     pad_to_batches,
@@ -550,3 +552,433 @@ class TestServeEndToEnd:
         tele = out["serve"]
         assert tele["tokens_generated"] == 8
         assert tele["pages"]["leaked"] == 0
+
+
+# ----------------------------------------------------------------------
+# PR 17: paged prefix cache — content keys + refcounted allocator
+# ----------------------------------------------------------------------
+
+class TestPrefixKeys:
+    def test_rolling_hash_keys_whole_prefix(self):
+        keys = page_prefix_keys(PROMPT, 4)
+        assert len(keys) == 2            # two FULL pages of 4
+        assert page_prefix_keys(PROMPT[:7], 4) == keys[:1]  # partial page
+        # unshared pages: same page-1 tokens but a different page 0 must
+        # change BOTH keys — the hash rolls over the whole prefix, not
+        # the page in isolation (position safety of the shared pages)
+        other = [1, 1, 1, 1] + PROMPT[4:]
+        assert page_prefix_keys(other, 4)[0] != keys[0]
+        assert page_prefix_keys(other, 4)[1] != keys[1]
+        # shared prefix, divergent tail: first key equal, second differs
+        fork = PROMPT[:4] + [2, 2, 2, 2]
+        assert page_prefix_keys(fork, 4)[0] == keys[0]
+        assert page_prefix_keys(fork, 4)[1] != keys[1]
+
+    def test_refcount_lifecycle(self):
+        a = PageAllocator(8)
+        p0, p1 = a.alloc(2)
+        a.register(b"k0", p0)
+        a.claim(p0)                      # a second sequence shares p0
+        assert a.refcount(p0) == 2 and a.in_use == 2
+        a.free([p0, p1])                 # first owner exits
+        assert a.refcount(p0) == 1       # still referenced — not cached
+        assert a.cached_pages == 0 and a.in_use == 1
+        a.free([p0])                     # last reference drops
+        assert a.in_use == 0 and a.cached_pages == 1
+        assert a.lookup([b"k0"]) == [p0]         # retained, KV intact
+        with pytest.raises(ValueError, match="double free"):
+            a.free([p0])                 # cached != free: still guarded
+        a.claim(p0)                      # resurrect off the LRU
+        assert a.cached_pages == 0 and a.refcount(p0) == 1
+        with pytest.raises(ValueError, match="no live reference"):
+            a.register(b"kX", p1)        # p1 went back to the free list
+        with pytest.raises(ValueError, match="neither"):
+            a.claim(7)                   # never allocated
+        # identity the telemetry gates on, at every state above
+        assert a.in_use + a.cached_pages + a.free_pages == 7
+
+    def test_lru_eviction_oldest_first_and_first_writer_wins(self):
+        a = PageAllocator(5)             # pages 1..4
+        pages = a.alloc(3)               # [1, 2, 3]
+        for i, p in enumerate(pages):
+            a.register(bytes([i]), p)
+        a.free(pages)                    # all three park on the LRU
+        assert a.cached_pages == 3 and a.free_pages == 1
+        # a 3-page ask: free list first (page 4), then evict the two
+        # OLDEST cached pages — their keys die, the newest survives
+        got = a.alloc(3)
+        assert got == [4, 1, 2] and a.cache_evictions == 2
+        assert a.lookup([bytes(), bytes([0])]) == []
+        assert a.lookup([bytes([2])]) == [3]
+        # first writer wins: key 2 is taken, and got[0] can carry only
+        # one key ever
+        assert a.register(bytes([2]), got[0]) is False
+        assert a.register(bytes([9]), got[0]) is True
+        assert a.register(bytes([10]), got[0]) is False
+
+    def test_lookup_stops_at_first_miss(self):
+        a = PageAllocator(8)
+        pages = a.alloc(3)
+        a.register(b"a", pages[0])
+        a.register(b"c", pages[2])
+        # consecutive-run semantics: a hole at key 1 hides page 2 even
+        # though its key is indexed (its CONTENT depends on pages 0-1)
+        assert a.lookup([b"a", b"b", b"c"]) == [pages[0]]
+
+
+class _AuditAllocator(PageAllocator):
+    """PageAllocator that re-checks the sharing invariants on every
+    operation: a page is never handed out while referenced, refcounts
+    mirror the claim/free history exactly, and the occupancy identity
+    ``in_use + cached + free == usable`` never breaks."""
+
+    def __init__(self, max_pages):
+        super().__init__(max_pages)
+        self.shadow: dict = {}
+        self.ops = 0
+
+    def _check(self):
+        self.ops += 1
+        live = {p for p, r in self.shadow.items() if r > 0}
+        assert len(live) == self.in_use, "in_use drifted from refcounts"
+        for p, r in self.shadow.items():
+            assert self.refcount(p) == r, f"page {p} refcount drifted"
+        assert (self.in_use + self.cached_pages + self.free_pages
+                == self.max_pages - 1), "occupancy identity broke"
+
+    def alloc(self, count):
+        got = super().alloc(count)
+        if got is not None:
+            for p in got:
+                assert self.shadow.get(p, 0) == 0, (
+                    f"page {p} recycled while referenced")
+                self.shadow[p] = 1
+        self._check()
+        return got
+
+    def free(self, pages):
+        super().free(pages)              # double-free raises in the base
+        for p in pages:
+            self.shadow[p] -= 1
+        self._check()
+
+    def claim(self, page):
+        super().claim(page)
+        self.shadow[page] = self.shadow.get(page, 0) + 1
+        self._check()
+
+
+class TestPrefixCache:
+    @pytest.mark.parametrize("fam", ["gpt", "llama", "llama_gqa"])
+    def test_hit_decode_trajectory_bitwise_vs_cold_twin(self, served, fam):
+        model, v = served(fam)
+        reqs = [Request(rid=i, prompt=PROMPT, max_new_tokens=6,
+                        temperature=0.0 if i == 0 else 0.8)
+                for i in range(2)]
+        # the cold twin: same engine config, cache OFF
+        cold = ContinuousBatchingScheduler(_engine(model, v)).run(
+            [Request(**dataclasses.asdict(r)) for r in reqs])
+        eng = _engine(model, v, prefix_cache=True)
+        sched = ContinuousBatchingScheduler(eng)
+        warm = sched.run(reqs)
+        for cc, cw in zip(cold["completions"], warm["completions"]):
+            assert cw.tokens == cc.tokens, (
+                f"rid {cw.rid}: prefix-hit trajectory diverged from the "
+                "cold twin")
+        # rid 0 was cold (2 prompt pages, 0 hits), rid 1 hit the one
+        # shareable page ((plen-1)//page_size caps the reuse at 1)
+        assert warm["page_reuse_ratio"] == pytest.approx(1 / 4)
+        assert warm["prefill_tokens_saved"] == 4
+        assert warm["pages"]["leaked"] == 0
+        assert warm["pages"]["cached_pages"] > 0
+        assert cold["page_reuse_ratio"] == 0.0
+
+    def test_shared_system_prompt_reuse_ratio(self, served):
+        model, v = served("gpt")
+        rng = np.random.default_rng(11)
+        sys_prefix = rng.integers(1, VOCAB, 8).tolist()
+        reqs = [Request(rid=i,
+                        prompt=sys_prefix + rng.integers(
+                            1, VOCAB, 4).tolist(),
+                        max_new_tokens=4)
+                for i in range(4)]
+        eng = _engine(model, v, prefix_cache=True)
+        out = ContinuousBatchingScheduler(eng).run(
+            [Request(**dataclasses.asdict(r)) for r in reqs])
+        # 12-token prompts: 3 prompt pages each, the 2 sys-prefix pages
+        # shareable; request 0 pays them cold, 1..3 hit both
+        assert out["page_reuse_ratio"] == pytest.approx(6 / 12)
+        assert out["prefill_tokens_saved"] == 3 * 8
+        assert out["pages"]["leaked"] == 0
+        # every stream still equals its solo cold run (one plain engine,
+        # reused: streams are batch- and cache-independent by design)
+        plain = _engine(model, v)
+        for r in reqs:
+            solo = ContinuousBatchingScheduler(plain, max_active=1).run(
+                [Request(rid=r.rid, prompt=r.prompt, max_new_tokens=4)])
+            got = next(c for c in out["completions"] if c.rid == r.rid)
+            assert got.tokens == solo["completions"][0].tokens
+
+    def test_page_never_recycled_while_referenced_property(self, served):
+        """Property-style sweep of the refcount invariants: shared
+        prefixes + a pool tight enough to force LRU evictions and
+        admission backpressure, plus timeout and EOS evictions — every
+        allocator operation re-audited (never recycled while referenced,
+        never double-freed, occupancy identity byte-exact)."""
+        model, v = served("gpt")
+        rng = np.random.default_rng(23)
+        sys_prefix = rng.integers(1, VOCAB, 8).tolist()
+
+        def mk(rid, tail, new=4):
+            return Request(rid=rid,
+                           prompt=sys_prefix + rng.integers(
+                               1, VOCAB, tail).tolist(),
+                           max_new_tokens=new)
+
+        eng = _engine(model, v, prefix_cache=True, prefill_chunk=4,
+                      max_pages=14)
+        eng.allocator = _AuditAllocator(14)
+        sched = ContinuousBatchingScheduler(eng)
+        out = sched.run([mk(i, 1 + (i % 5)) for i in range(8)])
+        assert out["page_reuse_ratio"] > 0
+        assert out["pages"]["peak_bytes"] == (
+            out["pages"]["peak_in_use"] * eng.page_bytes())
+        # timeout evictions (possibly mid-prefill) release cleanly too
+        out2 = ContinuousBatchingScheduler(
+            eng, request_timeout=1e-6).run(
+                [mk(100 + i, 3, new=8) for i in range(4)])
+        assert out2["timed_out"] == 4
+        # EOS on the very first token exercises the admission-time finish
+        eos = out["completions"][0].tokens[0]
+        ContinuousBatchingScheduler(eng, eos_id=eos).run(
+            [mk(200 + i, 1 + (i % 5)) for i in range(4)])
+        assert eng.allocator.in_use == 0, "references leaked"
+        assert eng.allocator.ops > 50
+        assert out["pages"]["leaked"] == 0 and out2["pages"]["leaked"] == 0
+
+    def test_zero_retraces_with_prefix_hits(self, served):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.xla_flags import (
+            compile_event_counts,
+            install_compile_counter,
+        )
+        model, v = served("gpt")
+        eng = _engine(model, v, prefix_cache=True, max_seq=48)
+        assert install_compile_counter()
+        rng = np.random.default_rng(5)
+        long_prompt = rng.integers(1, VOCAB, 16).tolist()
+        # warmup covers both buckets AND the hit path (rerunning PROMPT
+        # prefills only its tail, at a smaller effective length)
+        sched = ContinuousBatchingScheduler(eng)
+        sched.run([Request(rid=100, prompt=PROMPT, max_new_tokens=2),
+                   Request(rid=101, prompt=long_prompt, max_new_tokens=2)])
+        ContinuousBatchingScheduler(eng).run(
+            [Request(rid=102, prompt=PROMPT, max_new_tokens=2)])
+        before = compile_event_counts()
+        # steady state: full hits, partial hits, and cold prompts
+        out = ContinuousBatchingScheduler(eng).run(
+            [Request(rid=0, prompt=PROMPT, max_new_tokens=8),
+             Request(rid=1, prompt=PROMPT[:4] + [13, 17, 19, 23, 29, 31,
+                                                 37, 41],
+                     max_new_tokens=8),
+             Request(rid=2, prompt=rng.integers(1, VOCAB, 16).tolist(),
+                     max_new_tokens=8)])
+        after = compile_event_counts()
+        assert out["page_reuse_ratio"] > 0
+        assert after["traces"] == before["traces"], "hit-path retrace"
+        assert after["compiles"] == before["compiles"], "hit-path compile"
+
+    def test_engine_headroom_guard(self, served):
+        model, v = served("gpt")
+        # max_seq 24 @ page_size 4 = 6 pages/sequence; 7 pages in the
+        # pool leave 6 usable — one sequence pins everything, nothing
+        # could ever stay cached
+        with pytest.raises(ValueError, match="headroom"):
+            _engine(model, v, prefix_cache=True, max_pages=7)
+        _engine(model, v, prefix_cache=True, max_pages=8)   # fits
+
+
+# ----------------------------------------------------------------------
+# PR 17: chunked prefill — one [1, C] program interleaved into decode
+# ----------------------------------------------------------------------
+
+class TestChunkedPrefill:
+    @pytest.mark.parametrize("fam", ["gpt", "llama", "llama_gqa"])
+    @pytest.mark.parametrize("chunk", [4, 8])
+    def test_bitwise_logits_and_cache_vs_monolithic(self, served, fam,
+                                                    chunk):
+        model, v = served(fam)
+        prompt = np.asarray(PROMPT + [6, 2, 8, 3], np.int32)   # 12 tokens
+        kw = dict(prompt_buckets=(16,), max_seq=16)
+        em = _engine(model, v, **kw)
+        ec = _engine(model, v, prefill_chunk=chunk, **kw)
+        row_m = em.table_row(em.allocator.alloc(em.pages_for(16)))
+        row_c = ec.table_row(ec.allocator.alloc(ec.pages_for(16)))
+        tok_m, lg_m = em.prefill(prompt, row_m, 0.0, 7)
+        tok_c = lg_c = None
+        for s in range(0, len(prompt), chunk):
+            tok_c, lg_c = ec.prefill_chunk_step(
+                prompt[s:s + chunk], s, row_c, 0.0, 7)
+        assert tok_c == tok_m
+        np.testing.assert_array_equal(np.asarray(lg_c), np.asarray(lg_m))
+        # the sequence's written pages are bitwise the monolithic
+        # prefill's — chunked decode continues from EXACTLY the same
+        # state (page 0 is the trash page: bucket padding scribbles
+        # there, chunk-aligned spans don't, and decode never reads it)
+        np.testing.assert_array_equal(np.asarray(ec.kcache)[:, 1:5],
+                                      np.asarray(em.kcache)[:, 1:5])
+        np.testing.assert_array_equal(np.asarray(ec.vcache)[:, 1:5],
+                                      np.asarray(em.vcache)[:, 1:5])
+        assert ec.compiled_buckets == []   # no bucket ever specialized
+
+    def test_streams_identical_and_chunk_counts(self, served):
+        model, v = served("gpt")
+        rng = np.random.default_rng(3)
+        reqs = [Request(rid=i,
+                        prompt=rng.integers(1, VOCAB, 5 + 3 * i).tolist(),
+                        max_new_tokens=5,
+                        temperature=0.0 if i % 2 == 0 else 0.7)
+                for i in range(4)]                 # lengths 5, 8, 11, 14
+        mono = ContinuousBatchingScheduler(_engine(model, v)).run(
+            [Request(**dataclasses.asdict(r)) for r in reqs])
+        chk = ContinuousBatchingScheduler(
+            _engine(model, v, prefill_chunk=4)).run(reqs)
+        assert ([c.tokens for c in chk["completions"]]
+                == [c.tokens for c in mono["completions"]])
+        # ceil(plen / 4) chunks per prompt: 2 + 2 + 3 + 4
+        assert chk["prefill_chunks"] == 11
+        assert mono["prefill_chunks"] == 0
+        assert chk["prefill_buckets"] == []
+        assert chk["pages"]["leaked"] == 0
+
+    def test_chunks_interleave_with_running_decode(self, served):
+        model, v = served("gpt")
+        eng = _engine(model, v, prefill_chunk=4)
+        calls = []
+        orig_chunk, orig_decode = eng.prefill_chunk_step, eng.decode
+        eng.prefill_chunk_step = (
+            lambda *a, **k: (calls.append("chunk"),
+                             orig_chunk(*a, **k))[1])
+        eng.decode = (
+            lambda *a, **k: (calls.append("decode"),
+                             orig_decode(*a, **k))[1])
+        out = ContinuousBatchingScheduler(eng).run(
+            [Request(rid=0, prompt=PROMPT[:4], max_new_tokens=12),
+             Request(rid=1, prompt=PROMPT * 2, max_new_tokens=2)])
+        # the 16-token prompt prefills one chunk per scheduler tick WHILE
+        # rid 0 keeps decoding: some decode call lands strictly between
+        # two chunk calls instead of the monolithic stall
+        first, last = calls.index("chunk"), len(calls) - 1 - calls[
+            ::-1].index("chunk")
+        assert "decode" in calls[first:last], (
+            f"prefill was not interleaved with decode: {calls}")
+        assert out["pages"]["leaked"] == 0
+        # the short stream is unperturbed by the long prefill riding along
+        solo = ContinuousBatchingScheduler(
+            _engine(model, v, prefill_chunk=4)).run(
+                [Request(rid=0, prompt=PROMPT[:4], max_new_tokens=12)])
+        assert (next(c for c in out["completions"] if c.rid == 0).tokens
+                == solo["completions"][0].tokens)
+
+    def test_prompt_beyond_largest_bucket_admits(self, served):
+        model, v = served("gpt")
+        long_prompt = (PROMPT * 3)[:18]            # 18 > largest bucket 16
+        with pytest.raises(ValueError, match="exceeds the largest"):
+            ContinuousBatchingScheduler(_engine(model, v)).run(
+                [Request(rid=0, prompt=long_prompt, max_new_tokens=2)])
+        out = ContinuousBatchingScheduler(
+            _engine(model, v, prefill_chunk=4)).run(
+                [Request(rid=0, prompt=long_prompt, max_new_tokens=2)])
+        c = out["completions"][0]
+        assert c.reason == "length" and len(c.tokens) == 2
+        assert out["prefill_chunks"] == 5          # ceil(18 / 4)
+
+    def test_zero_retraces_chunked(self, served):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.xla_flags import (
+            compile_event_counts,
+            install_compile_counter,
+        )
+        model, v = served("gpt")
+        eng = _engine(model, v, prefill_chunk=4, max_seq=48)
+        assert install_compile_counter()
+        # ONE warm request (2 chunks) compiles the chunk program + decode
+        ContinuousBatchingScheduler(eng).run(
+            [Request(rid=100, prompt=PROMPT, max_new_tokens=2)])
+        before = compile_event_counts()
+        rng = np.random.default_rng(9)
+        out = ContinuousBatchingScheduler(eng).run(
+            [Request(rid=i, prompt=rng.integers(
+                1, VOCAB, 3 + 5 * i).tolist(), max_new_tokens=35)
+             for i in range(3)])                   # lengths 3, 8, 13
+        after = compile_event_counts()
+        assert out["decode_steps"] >= 32
+        assert after["traces"] == before["traces"], (
+            "chunked steady-state retrace — the [1, C] program must "
+            "cover every prompt length")
+        assert after["compiles"] == before["compiles"]
+
+    def test_engine_rejects_non_page_multiple_chunk(self, served):
+        model, v = served("gpt")
+        with pytest.raises(ValueError, match="multiple of page_size"):
+            _engine(model, v, prefill_chunk=3)
+        with pytest.raises(ValueError, match="multiple of page_size"):
+            _engine(model, v, prefill_chunk=-4)
+
+
+# ----------------------------------------------------------------------
+# PR 17 satellites: latency split + eager config validation
+# ----------------------------------------------------------------------
+
+class TestLatencyTelemetry:
+    def test_ttft_split_from_decode_gaps(self, served):
+        model, v = served("gpt")
+        out = ContinuousBatchingScheduler(_engine(model, v)).run(
+            [Request(rid=0, prompt=PROMPT, max_new_tokens=5)])
+        c = out["completions"][0]
+        assert c.ttft_s is not None and c.ttft_s > 0
+        # the first token's wall (prefill included) is NOT a decode gap
+        assert len(c.decode_latencies_s) == len(c.tokens) - 1
+        for key in ("p50", "p99", "mean"):
+            assert out["ttft_ms"][key] > 0
+            assert out["latency_ms"][key] > 0
+
+    def test_zero_filled_schema_on_empty_run(self, served):
+        model, v = served("gpt")
+        out = ContinuousBatchingScheduler(_engine(model, v)).run([])
+        zero = {"p50": 0.0, "p99": 0.0, "mean": 0.0}
+        assert out["latency_ms"] == zero
+        assert out["ttft_ms"] == zero
+        assert out["page_reuse_ratio"] == 0.0
+        assert out["prefill_tokens_saved"] == 0
+        assert out["prefill_chunks"] == 0
+        assert out["tokens_per_s"] == 0.0
+
+
+class TestServeFastPathConfig:
+    def test_chunk_must_be_positive_page_multiple(self):
+        with pytest.raises(ValueError, match="positive multiple"):
+            Config(serve_prefill_chunk=5)
+        with pytest.raises(ValueError, match="positive multiple"):
+            Config(serve_prefill_chunk=-16)
+        with pytest.raises(ValueError, match="positive multiple"):
+            Config(serve_prefill_chunk=24, serve_page_size=16)
+        assert Config(serve_prefill_chunk=32).serve_prefill_chunk == 32
+        assert Config(serve_prefill_chunk=24,
+                      serve_page_size=8).serve_prefill_chunk == 24
+
+    def test_prefix_cache_needs_pool_headroom(self):
+        # default buckets 16,64 + 16 new tokens = 80-token sequences =
+        # 5 pages @ page_size 16: a 6-page pool (5 usable) is pinned
+        # whole by one sequence — rejected with the real reason
+        with pytest.raises(ValueError, match="headroom"):
+            Config(serve_prefix_cache=True, serve_max_pages=6)
+        cfg = Config(serve_prefix_cache=True, serve_max_pages=7)
+        assert cfg.serve_prefix_cache
+
+    def test_fast_path_flags_rejected_outside_serve_mode(self):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import (
+            train_global,
+        )
+        for kw in (dict(serve_prefix_cache=True),
+                   dict(serve_prefill_chunk=16)):
+            with pytest.raises(ValueError, match="serving fast path"):
+                train_global(Config(**kw))
