@@ -1001,7 +1001,7 @@ def measure_ckpt() -> dict:
 
 
 def measure_serve() -> dict:
-    """Serving-engine A/Bs (ISSUE 7 + 17), three arms off one gpt_tiny:
+    """Serving-engine A/Bs (ISSUE 7 + 17 + 18), four arms off one gpt_tiny:
 
     1. **batching** — continuous batching vs the naive sequential-request
        baseline under the SAME Poisson arrival trace (the naive arm is
@@ -1023,6 +1023,13 @@ def measure_serve() -> dict:
        per step instead of the whole [1, 512] prefill wall, so the bar
        is p99 per-DECODE-token latency cut >= 2x with bitwise-identical
        streams.
+    4. **speculative decoding** — the SELF-SIMILAR trace (the draft
+       shares the target's params, so every proposal matches and
+       acceptance is deterministic — backend-robust where CPU wall
+       clocks are not) at k in {2, 4} vs the non-speculative twin.
+       Bars: bitwise-identical streams, and target-steps-per-emitted-
+       token < 0.5 at k=4 (full acceptance commits k tokens per verify,
+       so the measured ratio sits near 1/k).
 
     Every arm reports the byte-exact page-occupancy accounting
     (peak_bytes must equal peak pages x the per-page pin across both
@@ -1187,6 +1194,55 @@ def measure_serve() -> dict:
             "page_accounting_exact": account(eng, tele),
         }, streams
 
+    # -- arm 4: speculative decoding on the self-similar trace ----------
+    srng = np.random.default_rng(31)
+    sp_prompts = [srng.integers(1, vocab,
+                                int(srng.integers(4, 13))).tolist()
+                  for _ in range(8)]
+
+    def spec_arm(k):
+        def mk(**kw):
+            return ServeEngine(model, variables["params"], max_batch=4,
+                               page_size=8, max_pages=64,
+                               prompt_buckets=(16,), max_seq=32 + k,
+                               seed=0, **kw)
+        eng = (mk(draft=mk(), spec_tokens=k) if k else mk())
+        reqs = [Request(rid=i, prompt=sp_prompts[i],
+                        max_new_tokens=max_new)
+                for i in range(len(sp_prompts))]
+        ContinuousBatchingScheduler(eng, eos_id=-1).run(
+            [Request(rid=10_000_000, prompt=sp_prompts[0],
+                     max_new_tokens=2)])
+        tele = ContinuousBatchingScheduler(eng, eos_id=-1).run(reqs)
+        return {
+            "tokens_per_s": tele["tokens_per_s"],
+            "wall_s": tele["wall_s"],
+            "latency_ms": tele["latency_ms"],
+            "spec": tele["spec"],
+            "pages": tele["pages"],
+            "page_accounting_exact": account(eng, tele),
+        }, [c.tokens for c in tele["completions"]]
+
+    sp_base, sp_base_streams = spec_arm(0)
+    sp_by_k = {}
+    sp_bitwise = True
+    for k in (2, 4):
+        arm, streams = spec_arm(k)
+        sp_bitwise = sp_bitwise and streams == sp_base_streams
+        sp_by_k[f"k{k}"] = arm
+    speculative = {
+        "requests": len(sp_prompts), "trace": "self_similar",
+        "baseline": sp_base, **sp_by_k,
+        "acceptance_rate": sp_by_k["k4"]["spec"]["acceptance_rate"],
+        "target_steps_per_token": (
+            sp_by_k["k4"]["spec"]["target_steps_per_token"]),
+        "tokens_per_s_ratio": (round(sp_by_k["k4"]["tokens_per_s"]
+                                     / sp_base["tokens_per_s"], 2)
+                               if sp_base["tokens_per_s"] else None),
+        # the gate: greedy speculative output is bitwise the twin's
+        "spec_bitwise": bool(sp_bitwise),
+    }
+
     cp_mono, cp_mono_streams = chunk_arm(0)
     cp_chunk, cp_chunk_streams = chunk_arm(16)
     mono_p99 = cp_mono["latency_ms"]["p99"]
@@ -1211,6 +1267,7 @@ def measure_serve() -> dict:
                                  if naive["tokens_per_s"] else None),
         "prefix_cache": prefix_cache,
         "chunked_prefill": chunked_prefill,
+        "speculative": speculative,
     }
 
 
@@ -2244,12 +2301,16 @@ def _emit_headline(details: dict, extra: dict) -> None:
         elif key == "serve_engine":
             pc = e.get("prefix_cache") or {}
             cp = e.get("chunked_prefill") or {}
+            sp = e.get("speculative") or {}
             d[sk] = {"x": e.get("speedup_tokens_per_s"),
                      "reuse": pc.get("page_reuse_ratio"),
                      "rx": pc.get("tokens_per_s_ratio"),
                      "p99x": cp.get("p99_decode_latency_cut_x"),
+                     "acc": sp.get("acceptance_rate"),
+                     "tspt": sp.get("target_steps_per_token"),
                      "same": 1 if (pc.get("prefix_hit_bitwise")
-                                   and cp.get("chunked_bitwise")) else 0}
+                                   and cp.get("chunked_bitwise")
+                                   and sp.get("spec_bitwise")) else 0}
         elif key == "elastic_membership":
             d[sk] = {"st": e.get("reshard_stall_ms"),
                      "rd": e.get("steady_round_ms"),

@@ -367,6 +367,17 @@ class Config:
     # positive multiple of --serve_page_size (chunk boundaries must land
     # on page boundaries); 0 = the monolithic per-bucket path.
     serve_prefill_chunk: int = 0
+    # Speculative decoding (ISSUE 18): a small DRAFT model (its own
+    # sharded checkpoint, loaded through the same manifest path) runs k
+    # fixed-shape greedy decode steps per scheduler tick through its own
+    # paged KV pool; the target scores all k+1 positions in ONE [B, k+1]
+    # verify program with the accept/reject fused on, committing the
+    # longest accepted prefix + one bonus token.  Greedy speculative
+    # output is BITWISE the non-speculative twin's — the speedup is
+    # provably free.  Both flags or neither; greedy only
+    # (--serve_temperature 0).
+    serve_draft_ckpt: str = ""    # draft checkpoint dir ("" = off)
+    serve_spec_tokens: int = 0    # draft tokens per verify (k); 0 = off
     # --- scenario lab: vmap'd many-worker simulator (ISSUE 14) -------------
     # sim_workers: > 0 runs the ENTIRE local-SGD round for that many
     # workers as one vmap'd, donated jit on a SINGLE chip — per-worker
@@ -603,20 +614,45 @@ class Config:
                 f"boundaries must land on page boundaries so every chunk "
                 f"writes whole pages (and the prefix cache can key them) "
                 f"— got {self.serve_prefill_chunk}; 0 disables chunking")
+        # speculative decoding (ISSUE 18): every v1 limit rejected
+        # eagerly with its real reason, never three ticks into a run
+        if bool(self.serve_draft_ckpt) != bool(self.serve_spec_tokens):
+            raise ValueError(
+                "--serve_draft_ckpt and --serve_spec_tokens arm "
+                "speculative decoding TOGETHER (the draft proposes, k "
+                "sizes the verify program) — one without the other is "
+                f"inert; got draft_ckpt={self.serve_draft_ckpt!r}, "
+                f"spec_tokens={self.serve_spec_tokens}")
+        if self.serve_spec_tokens < 0:
+            raise ValueError(
+                f"--serve_spec_tokens must be >= 1 (0 disables), got "
+                f"{self.serve_spec_tokens}")
+        if self.serve_draft_ckpt and self.serve_temperature > 0.0:
+            raise ValueError(
+                f"--serve_temperature {self.serve_temperature} with "
+                "--serve_draft_ckpt: v1 speculative acceptance is greedy "
+                "argmax equality against the verify logits — temperature "
+                "sampling needs the stochastic rejection-sampling rule "
+                "(accept with prob min(1, p_target/p_draft)) that is not "
+                "implemented; serve greedy or drop the draft")
         buckets = self.parse_prompt_buckets()   # validates the csv eagerly
         if self.serve_prefix_cache:
             # the serve engine sizes sequences at max_seq = largest
-            # bucket + serve_max_new_tokens; if ONE such sequence can pin
-            # the whole pool there is never a refcount-0 page to retain,
-            # so the cache could only ever thrash — reject eagerly
-            longest = buckets[-1] + self.serve_max_new_tokens
+            # bucket + serve_max_new_tokens (+ spec_tokens of verify
+            # overshoot); if ONE such sequence can pin the whole pool
+            # there is never a refcount-0 page to retain, so the cache
+            # could only ever thrash — reject eagerly
+            longest = (buckets[-1] + self.serve_max_new_tokens
+                       + self.serve_spec_tokens)
             seq_pages = -(-longest // self.serve_page_size)
             if seq_pages >= self.serve_max_pages - 1:
                 raise ValueError(
                     f"--serve_prefix_cache needs page-pool headroom "
                     f"beyond one max-length sequence: a {longest}-token "
                     f"sequence (largest bucket {buckets[-1]} + "
-                    f"serve_max_new_tokens {self.serve_max_new_tokens}) "
+                    f"serve_max_new_tokens {self.serve_max_new_tokens}"
+                    + (f" + serve_spec_tokens {self.serve_spec_tokens}"
+                       if self.serve_spec_tokens else "") + ") "
                     f"pins {seq_pages} of the {self.serve_max_pages - 1} "
                     f"usable pages (page 0 is the trash page), so no "
                     f"page could ever stay cached — raise "
@@ -1460,6 +1496,18 @@ def build_argparser() -> argparse.ArgumentParser:
                         "interleaved with decode steps instead of one "
                         "monolithic per-bucket program (positive "
                         "multiple of --serve_page_size; 0 = monolithic)")
+    p.add_argument("--serve_draft_ckpt", type=str,
+                   default=d.serve_draft_ckpt,
+                   help="serve: sharded checkpoint dir of a small DRAFT "
+                        "model for speculative decoding — k greedy "
+                        "draft steps per tick through a second paged KV "
+                        "pool, one fused [B, k+1] target verify; greedy "
+                        "output stays bitwise the non-speculative "
+                        "twin's (needs --serve_spec_tokens)")
+    p.add_argument("--serve_spec_tokens", type=int,
+                   default=d.serve_spec_tokens,
+                   help="serve: draft tokens per verify step (k >= 1; "
+                        "0 = no speculation; needs --serve_draft_ckpt)")
     # --- chaos / elastic membership group (ISSUE 8) ------------------------
     p.add_argument("--chaos", type=str, default=d.chaos,
                    help="fault-injection plan: comma-separated "

@@ -252,13 +252,15 @@ def train_global(cfg: Config, *, mesh=None, simulated_durations=None,
     and initial partition; membership events at rounds <= the snapshot's
     epoch are already baked into its roster and are not replayed.
     """
-    if cfg.serve_prefix_cache or cfg.serve_prefill_chunk:
+    if (cfg.serve_prefix_cache or cfg.serve_prefill_chunk
+            or cfg.serve_draft_ckpt or cfg.serve_spec_tokens):
         # the other --serve_* knobs are inert engine defaults a training
-        # run can carry harmlessly; these two are behavior switches of
+        # run can carry harmlessly; these are behavior switches of
         # the serving fast path and mean nothing to training — reject
         # instead of silently ignoring them
         raise ValueError(
-            "--serve_prefix_cache/--serve_prefill_chunk configure the "
+            "--serve_prefix_cache/--serve_prefill_chunk/"
+            "--serve_draft_ckpt/--serve_spec_tokens configure the "
             "serving fast path and only apply under `main.py serve` — "
             "the training driver never runs the serve engine; drop the "
             "flags from this run")

@@ -53,6 +53,16 @@ def get_model(name: str, **kw: Any):
         kw.setdefault("num_heads", 4)
         kw.setdefault("ffn_dim", 128)
         return GPTForCausalLM(**kw)
+    if name == "gpt_small":
+        # CPU-trainable middle size between gpt_tiny and gpt2_small —
+        # the speculative-decoding TARGET of the draft/target smoke
+        # (gpt_tiny drafts for it: same vocab, ~4x the per-step work)
+        from .gpt import GPTForCausalLM
+        kw.setdefault("num_layers", 4)
+        kw.setdefault("hidden", 128)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("ffn_dim", 256)
+        return GPTForCausalLM(**kw)
     if name == "llama_medium":
         from .llama import LlamaForCausalLM
         return LlamaForCausalLM(**kw)
@@ -148,6 +158,7 @@ MODEL_INPUT_SPECS = {
     "bert_base": ((128,), 30522),
     "bert_tiny": ((128,), 30522),
     "gpt2_small": ((128,), 50257),
+    "gpt_small": ((128,), 50257),
     "gpt_tiny": ((128,), 50257),
     "llama_medium": ((1024,), 32000),
     "llama_tiny": ((128,), 32000),

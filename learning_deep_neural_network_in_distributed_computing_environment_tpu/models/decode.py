@@ -299,6 +299,44 @@ def forward_paged(spec: DecodeSpec, params, tokens, lengths, num_valid,
     return logits, k_pages, v_pages
 
 
+def speculative_accept(logits, draft):
+    """Fused greedy accept/reject of one speculation burst (ISSUE 18).
+
+    ``logits [B, k+1, vocab]`` are the TARGET model's verify logits at
+    positions ``C .. C+k`` (the pending token plus the k drafted
+    tokens); ``draft [B, k]`` the draft model's proposals.  Greedy-only:
+    the target's token at position ``C+j`` is ``t_j = argmax`` — the
+    bitwise-identical twin of the non-speculative decode step's
+    ``sample_tokens`` at temperature 0.
+
+    Acceptance is CAPPED at ``k - 1`` drafted tokens, with the bonus
+    token always emitted: ``acc = min(longest matching prefix, k-1)``,
+    ``emitted = d_1 .. d_acc, t_acc``.  The cap costs nothing (when all
+    k drafts match, the bonus ``t_{k-1}`` IS ``d_k``, so the emitted
+    stream is identical) and buys the cache invariant the schedule
+    rides on: after committing ``acc + 1`` tokens both KV pools are
+    filled exactly to the new length — the draft pool wrote positions
+    ``C .. C+k-1`` and ``acc + 1 <= k`` always, so no catch-up program
+    of a second shape ever exists.  Rejected positions hold garbage at
+    ``>= new length``; the next burst overwrites them before the causal
+    mask can see them, so rollback is pure page-table arithmetic (no
+    zeroing).
+
+    Returns ``(emitted [B, k] int32, acc [B] int32)``: row i's burst is
+    ``emitted[i, :acc[i] + 1]``; tail entries are -1.
+    """
+    k = draft.shape[1]
+    tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)        # [B, k+1]
+    match = (draft == tgt[:, :-1]).astype(jnp.int32)           # [B, k]
+    n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)        # [B]
+    acc = jnp.minimum(n_acc, k - 1).astype(jnp.int32)
+    bonus = jnp.take_along_axis(tgt, acc[:, None], axis=1)     # [B, 1]
+    idx = jnp.arange(k, dtype=jnp.int32)[None, :]
+    emitted = jnp.where(idx < acc[:, None], draft,
+                        jnp.where(idx == acc[:, None], bonus, -1))
+    return emitted.astype(jnp.int32), acc
+
+
 def sample_tokens(logits, temps, rids, gen_pos, seed: int):
     """Greedy (temp <= 0) or temperature sampling of one token per row.
 

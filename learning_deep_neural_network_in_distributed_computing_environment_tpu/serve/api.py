@@ -114,13 +114,28 @@ def run_serve(cfg, requests: Optional[list] = None, *,
         log.info("serve: no manifest metadata; rebuilt %s (vocab %d from "
                  "manifest leaf shapes)", cfg.model, ncls)
     buckets = cfg.parse_prompt_buckets()
-    engine = ServeEngine.from_checkpoint(
-        path, model=model, max_batch=cfg.serve_max_batch,
-        page_size=cfg.serve_page_size, max_pages=cfg.serve_max_pages,
-        prompt_buckets=buckets,
-        max_seq=buckets[-1] + cfg.serve_max_new_tokens,
+    # identical geometry for both engines of a speculative pair (the
+    # pairing check enforces it): one page-table schedule, one filled
+    # offset, joint admission.  max_seq grows by k — the verify program
+    # writes up to position C + k
+    engine_kw = dict(
+        max_batch=cfg.serve_max_batch, page_size=cfg.serve_page_size,
+        max_pages=cfg.serve_max_pages, prompt_buckets=buckets,
+        max_seq=(buckets[-1] + cfg.serve_max_new_tokens
+                 + cfg.serve_spec_tokens),
         seed=cfg.seed, prefix_cache=cfg.serve_prefix_cache,
         prefill_chunk=cfg.serve_prefill_chunk)
+    draft = None
+    if cfg.serve_draft_ckpt:
+        # the draft self-configures from ITS manifest metadata (there is
+        # only one --model flag, and it belongs to the target); every
+        # pairing rejection — vocab mismatch, MoE draft — fires inside
+        # the ServeEngine constructor below, before any request runs
+        draft = ServeEngine.from_checkpoint(cfg.serve_draft_ckpt,
+                                            **engine_kw)
+    engine = ServeEngine.from_checkpoint(
+        path, model=model, draft=draft,
+        spec_tokens=cfg.serve_spec_tokens, **engine_kw)
     if requests is None:
         requests = build_requests(cfg, engine.spec.vocab)
 

@@ -189,6 +189,49 @@ class PageAllocator:
         return hits
 
 
+def paired_admit(target: PageAllocator, draft: PageAllocator,
+                 hits_t: list[int], hits_d: list[int], count: int
+                 ) -> tuple[list[int], list[int]] | None:
+    """All-or-nothing admission across a (target, draft) allocator pair
+    (ISSUE 18, speculative decoding).
+
+    A speculating sequence needs its FULL page span in BOTH pools before
+    it may start: the draft writes positions ``C .. C+k-1`` and the
+    verify writes ``C .. C+k`` every tick, so a pair that ran out of
+    pages mid-decode in either pool would deadlock (each pool's pages
+    are pinned by sequences waiting on the other).  This claims the
+    prefix-cache hits and allocates the fresh pages target-first, and on
+    ANY failure rolls BOTH pools back to their entry state — the request
+    stays queued (admission backpressure), and a running pair can never
+    wait on pages.
+
+    ``hits_t``/``hits_d`` must cover the same token prefix (the caller
+    trims both to the shorter run, so the two pools share one filled
+    offset); ``count`` is the page span per pool.  Returns
+    ``(target_pages, draft_pages)`` or None.
+    """
+    if len(hits_t) != len(hits_d):
+        raise ValueError(
+            f"paired admission needs hit runs of equal length (one "
+            f"shared filled offset), got {len(hits_t)}/{len(hits_d)}")
+    for p in hits_t:
+        target.claim(p)
+    fresh_t = target.alloc(count - len(hits_t))
+    if fresh_t is None:
+        if hits_t:
+            target.free(hits_t)
+        return None
+    for p in hits_d:
+        draft.claim(p)
+    fresh_d = draft.alloc(count - len(hits_d))
+    if fresh_d is None:
+        if hits_d:
+            draft.free(hits_d)
+        target.free(hits_t + fresh_t)
+        return None
+    return hits_t + fresh_t, hits_d + fresh_d
+
+
 def page_table_row(pages: list[int], pages_per_seq: int) -> np.ndarray:
     """A sequence's page-table row: its pages in position order, the
     unreachable tail pointed at the trash page."""

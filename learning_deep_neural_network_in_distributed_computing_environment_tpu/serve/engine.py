@@ -19,6 +19,15 @@ prefill math, called repeatedly at successive cache offsets, so a single
 executable covers every prompt length and the compiled-program set
 shrinks from one-per-bucket to exactly two.
 
+With a ``draft`` engine paired (ISSUE 18, speculative decoding) the
+target's decode step is replaced by **verify** — ONE fixed ``[B, k+1]``
+program scoring the pending token plus k draft proposals per slot while
+writing the target KV pages, with the greedy accept/reject/rollback
+math fused on (``models.decode.speculative_accept``).  The steady-state
+hot loop is then exactly three compiled programs per pair: the draft
+engine's decode step (run k times per tick at temperature 0), verify,
+and the accept fused into verify.
+
 Both donate the cache buffers (the pools are the big arrays; a decode
 step must not double them) and both end in ``models.decode.sample_tokens``
 so greedy/temperature sampling costs no third program.
@@ -97,6 +106,26 @@ def _build_prefill_program(spec: D.DecodeSpec, seed: int):
         return nxt[0], last, kc, vc
 
     return jax.jit(prefill_step, donate_argnums=(1, 2))
+
+
+def _build_verify_program(spec: D.DecodeSpec, k: int):
+    """Score one speculation burst in ONE fixed ``[B, k+1]`` program
+    (ISSUE 18): the chunked-prefill machinery generalized to the decode
+    batch — ``forward_paged`` at the current lengths returns per-position
+    logits while writing the target KV pages for positions ``C .. C+k``,
+    and the accept/reject/rollback math (``D.speculative_accept``) is
+    FUSED onto the same program, so the burst costs one dispatch and
+    only ``(emitted [B, k], acc [B])`` ever crosses to the host.
+    Inactive rows ride along masked (``num_valid = 0`` routes their
+    writes to the trash page), exactly like the decode step."""
+    def verify_step(params, kc, vc, tokens, lengths, page_table, active):
+        num_valid = jnp.where(active, k + 1, 0).astype(jnp.int32)
+        logits, kc, vc = D.forward_paged(
+            spec, params, tokens, lengths, num_valid, page_table, kc, vc)
+        emitted, acc = D.speculative_accept(logits, tokens[:, 1:])
+        return emitted, acc, kc, vc
+
+    return jax.jit(verify_step, donate_argnums=(1, 2))
 
 
 # ----------------------------------------------------------------------
@@ -330,7 +359,9 @@ class ServeEngine:
                  page_size: int = 16, max_pages: int = 64,
                  prompt_buckets=(16, 64), max_seq: Optional[int] = None,
                  mesh=None, seed: int = 0, prefix_cache: bool = False,
-                 prefill_chunk: int = 0):
+                 prefill_chunk: int = 0,
+                 draft: Optional["ServeEngine"] = None,
+                 spec_tokens: int = 0):
         self.spec = D.spec_from_model(model)
         self.model = model
         if page_size < 1 or max_batch < 1:
@@ -405,6 +436,62 @@ class ServeEngine:
             "prefill_chunk", _build_prefill_program(self.spec, self.seed))
             if self.prefill_chunk else None)
         self.compiled_buckets: list[int] = []
+        # speculative decoding (ISSUE 18): pair a DRAFT engine onto this
+        # (target) one.  Every pairing constraint is checked eagerly —
+        # a bad pair fails at construction with the real reason, never
+        # three ticks into a serve run
+        self.draft = draft
+        self.spec_tokens = int(spec_tokens)
+        self._verify = None
+        if (draft is None) != (self.spec_tokens == 0):
+            raise ValueError(
+                "speculative decoding needs BOTH a draft engine and "
+                "spec_tokens >= 1 (--serve_draft_ckpt + "
+                "--serve_spec_tokens): the draft proposes, spec_tokens "
+                "sizes the verify program — one without the other is "
+                "inert")
+        if draft is not None:
+            if self.spec_tokens < 1:
+                raise ValueError(
+                    f"spec_tokens must be >= 1, got {self.spec_tokens}")
+            if draft.spec.vocab != self.spec.vocab:
+                raise ValueError(
+                    f"draft/target vocabulary mismatch ({draft.spec.vocab}"
+                    f" vs {self.spec.vocab}): the draft proposes TOKEN "
+                    "IDS that the target's verify logits score — the two "
+                    "models must share one id space, or acceptance would "
+                    "compare ids from different vocabularies")
+            if draft.spec.num_experts:
+                raise ValueError(
+                    "MoE draft model rejected: the serving MoE decode "
+                    "computes EVERY expert's FFN densely and combines by "
+                    "the top-1 gate (models/decode._moe_ffn), so an MoE "
+                    "draft costs strictly more per step than its dense "
+                    "twin of the same hidden size — a draft exists to be "
+                    "cheap; use a dense draft checkpoint")
+            if draft.draft is not None:
+                raise ValueError("draft engines cannot nest: the draft "
+                                 "of a pair must be a plain engine")
+            mismatch = [
+                (n, getattr(draft, n), getattr(self, n))
+                for n in ("max_batch", "page_size", "max_seq",
+                          "prompt_buckets", "prefill_chunk",
+                          "prefix_cache")
+                if getattr(draft, n) != getattr(self, n)]
+            mismatch += [("max_pages", draft.allocator.max_pages,
+                          self.allocator.max_pages)
+                         ] if (draft.allocator.max_pages
+                               != self.allocator.max_pages) else []
+            if mismatch:
+                raise ValueError(
+                    "draft/target engine geometry must match so the two "
+                    "page pools stay position-for-position paired (one "
+                    "page table schedule, joint admission): mismatched "
+                    + ", ".join(f"{n} ({a} vs {b})"
+                                for n, a, b in mismatch))
+            self._verify = TrackedProgram(
+                "verify",
+                _build_verify_program(self.spec, self.spec_tokens))
 
     def memory_programs(self) -> dict:
         """Label -> TrackedProgram registry (the serve twin of
@@ -419,6 +506,16 @@ class ServeEngine:
                 # an uncompiled bucket program is absence, not an AOT
                 # fallback, so don't let it flip ``available`` off
                 del out["prefill"]
+        if self.draft is not None:
+            # speculative pair: the target's hot program is the fused
+            # verify; its plain decode step never dispatches (absence,
+            # like the bucket-prefill case above).  The draft's programs
+            # report under a draft_ prefix so one memory table covers
+            # the whole pair
+            out["verify"] = self._verify
+            del out["decode_step"]
+            out.update({f"draft_{k}": v
+                        for k, v in self.draft.memory_programs().items()})
         return out
 
     # -- construction from a sharded checkpoint ------------------------
@@ -549,3 +646,21 @@ class ServeEngine:
             jnp.asarray(temps, jnp.float32), jnp.asarray(rids, jnp.int32),
             jnp.asarray(active, jnp.bool_))
         return np.asarray(nxt), logits
+
+    def verify(self, tokens, lengths, page_table, active
+               ) -> tuple[np.ndarray, np.ndarray]:
+        """Score one speculation burst: ``tokens [B, k+1]`` (pending
+        token + k draft proposals per row) at cache offsets ``lengths``;
+        writes the target KV for positions ``C .. C+k`` and returns the
+        fused accept verdict ``(emitted [B, k], acc [B])`` on host —
+        row i commits ``emitted[i, :acc[i] + 1]``.  Greedy-only by
+        construction (the eager config rejection keeps temperature x
+        speculation out)."""
+        if self._verify is None:
+            raise RuntimeError("engine built without a draft pair")
+        emitted, acc, self.kcache, self.vcache = self._verify(
+            self.params, self.kcache, self.vcache,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(lengths, jnp.int32), jnp.asarray(page_table),
+            jnp.asarray(active, jnp.bool_))
+        return np.asarray(emitted), np.asarray(acc)

@@ -31,6 +31,20 @@ stream whether it ran alone or packed with others (the
 batched-vs-single gate), and a prefix-cache hit decodes the identical
 stream as its cold-cache twin (the PR 17 gate).
 
+With a draft engine paired (ISSUE 18) step 3 becomes one SPECULATION
+tick: k fixed-shape greedy draft decode steps propose d_1..d_k (the
+draft pool advancing in lockstep), one fused ``[B, k+1]`` verify scores
+the pending token + proposals through the target and returns the
+accepted prefix + bonus per slot, and the commit advances both pools'
+position counters by ``acc + 1`` — acceptance is capped at k-1 (the
+bonus then equals the k-th draft, so the emitted stream is unchanged)
+which keeps both caches exactly filled to the new length every tick:
+rollback is pure page-table arithmetic, rejected KV rows are recycled
+in place by the next burst's masked writes, and the greedy stream stays
+bitwise equal to the non-speculative twin's.  Admission claims the full
+span in BOTH pools all-or-nothing (``cache.paired_admit``) so a running
+pair can never deadlock on pages.
+
 Latency telemetry splits per request into TTFT (admission → first
 token — covers prefill, however it is scheduled) and per-DECODE-token
 gaps; both distributions zero-fill to 0.0 on empty runs, like
@@ -46,7 +60,7 @@ from typing import Optional
 
 import numpy as np
 
-from .cache import page_prefix_keys
+from .cache import page_prefix_keys, paired_admit
 from .engine import ServeEngine
 
 
@@ -87,6 +101,8 @@ class _Slot:
     t_last: float
     t_admit: float = 0.0          # wall clock at admission (timeout base)
     ttft_s: Optional[float] = None
+    draft_pages: Optional[list] = None   # draft-pool twin span (spec mode)
+    draft_row: Optional[np.ndarray] = None
 
     @property
     def prefilling(self) -> bool:
@@ -117,7 +133,15 @@ class ContinuousBatchingScheduler:
                       "decode_steps": 0, "tokens_generated": 0,
                       "timed_out": 0, "prefill_chunks": 0,
                       "prefix_hit_pages": 0, "prefix_prompt_pages": 0,
-                      "prefill_tokens_saved": 0}
+                      "prefill_tokens_saved": 0,
+                      # speculation counters (stay 0 without a draft):
+                      # drafted = k per active slot per tick; accepted =
+                      # the committed draft tokens (the bonus is a
+                      # TARGET token and never counts); emitted = all
+                      # committed tokens of the decode phase
+                      "draft_steps": 0, "verify_steps": 0,
+                      "spec_drafted": 0, "spec_accepted": 0,
+                      "spec_emitted": 0}
         self._occupancy: list[int] = []
 
     # -- request validation (fail at submit, not mid-run) ---------------
@@ -142,11 +166,23 @@ class ContinuousBatchingScheduler:
             raise ValueError(
                 f"request {r.rid}: prompt length {plen} exceeds the "
                 f"largest prefill bucket {eng.prompt_buckets[-1]}")
-        total = plen + r.max_new_tokens
+        if eng.draft is not None and r.temperature > 0.0:
+            raise ValueError(
+                f"request {r.rid}: temperature {r.temperature} under "
+                "speculative decoding — acceptance is greedy argmax "
+                "equality against the verify logits; temperature "
+                "sampling needs the stochastic rejection-sampling rule "
+                "v1 does not implement.  Serve it at temperature 0 or "
+                "without --serve_draft_ckpt")
+        # a speculating sequence's verify program writes up to position
+        # C + k, so its page span (in BOTH pools) covers k extra tokens
+        total = plen + r.max_new_tokens + eng.spec_tokens
         if total > eng.max_seq:
             raise ValueError(
-                f"request {r.rid}: prompt + max_new ({total}) exceeds "
-                f"max_seq {eng.max_seq}")
+                f"request {r.rid}: prompt + max_new"
+                + (f" + spec_tokens ({total})" if eng.spec_tokens
+                   else f" ({total})")
+                + f" exceeds max_seq {eng.max_seq}")
         if eng.pages_for(total) > eng.allocator.max_pages - 1:
             raise ValueError(
                 f"request {r.rid}: needs {eng.pages_for(total)} pages but "
@@ -162,26 +198,45 @@ class ContinuousBatchingScheduler:
                 or sum(s is not None for s in slots) >= self.max_active):
             return False
         plen = len(r.prompt)
+        dra = eng.draft
         keys: list = []
         hits: list = []
+        d_hits: list = []
         if eng.prefix_cache:
             keys = page_prefix_keys(r.prompt, eng.page_size)
             # never reuse past (plen - 1): the tail prefill must keep at
             # least one real token so it produces the first-token logits
-            hits = eng.allocator.lookup(keys[:(plen - 1) // eng.page_size])
-        # claim the hits BEFORE the fresh alloc: alloc may evict
-        # refcount-0 cached pages to cover a shortfall, and a claimed
-        # page can never be on that LRU
-        for p in hits:
-            eng.allocator.claim(p)
-        fresh = eng.allocator.alloc(
-            eng.pages_for(plen + r.max_new_tokens) - len(hits))
-        if fresh is None:
-            if hits:
-                eng.allocator.free(hits)
-            self.stats["admission_blocked"] += 1
-            return False
-        pages = hits + fresh
+            lim = keys[:(plen - 1) // eng.page_size]
+            hits = eng.allocator.lookup(lim)
+            if dra is not None:
+                # both pools prefill from ONE shared filled offset, so
+                # the usable hit run is the shorter of the two pools'
+                d_hits = dra.allocator.lookup(lim)
+                nj = min(len(hits), len(d_hits))
+                hits, d_hits = hits[:nj], d_hits[:nj]
+        count = eng.pages_for(plen + r.max_new_tokens + eng.spec_tokens)
+        d_pages: Optional[list] = None
+        if dra is None:
+            # claim the hits BEFORE the fresh alloc: alloc may evict
+            # refcount-0 cached pages to cover a shortfall, and a claimed
+            # page can never be on that LRU
+            for p in hits:
+                eng.allocator.claim(p)
+            fresh = eng.allocator.alloc(count - len(hits))
+            if fresh is None:
+                if hits:
+                    eng.allocator.free(hits)
+                self.stats["admission_blocked"] += 1
+                return False
+            pages = hits + fresh
+        else:
+            # speculative pair: the whole span in BOTH pools or nothing
+            got = paired_admit(eng.allocator, dra.allocator, hits,
+                               d_hits, count)
+            if got is None:
+                self.stats["admission_blocked"] += 1
+                return False
+            pages, d_pages = got
         row = eng.table_row(pages)
         hit_tok = len(hits) * eng.page_size
         if eng.prefix_cache:
@@ -194,10 +249,19 @@ class ContinuousBatchingScheduler:
                      filled=hit_tok, length=plen,
                      temperature=r.temperature, max_new=r.max_new_tokens,
                      generated=[], decode_lat=[], keys=keys,
-                     registered=len(hits), t_last=t_adm, t_admit=t_adm)
+                     registered=len(hits), t_last=t_adm, t_admit=t_adm,
+                     draft_pages=d_pages,
+                     draft_row=(eng.table_row(d_pages)
+                                if d_pages is not None else None))
         if not eng.prefill_chunk:
             first, _ = eng.prefill(slot.prompt[hit_tok:], row,
                                    r.temperature, r.rid, offset=hit_tok)
+            if dra is not None:
+                # the draft pool prefills the same prompt span so both
+                # caches sit at one filled offset; its sampled token is
+                # discarded — the pending token is ALWAYS the target's
+                dra.prefill(slot.prompt[hit_tok:], slot.draft_row,
+                            0.0, r.rid, offset=hit_tok)
             now = time.perf_counter()
             slot.generated = [first]
             slot.filled = plen
@@ -220,6 +284,12 @@ class ContinuousBatchingScheduler:
         nfull = min(slot.filled // self.engine.page_size, len(slot.keys))
         for i in range(slot.registered, nfull):
             self.engine.allocator.register(slot.keys[i], slot.pages[i])
+            if slot.draft_pages is not None:
+                # token-content keys are pool-agnostic: the draft pool's
+                # twin page publishes under the SAME key in its own
+                # allocator, so both pools hit together on reuse
+                self.engine.draft.allocator.register(
+                    slot.keys[i], slot.draft_pages[i])
         slot.registered = max(slot.registered, nfull)
 
     def _advance_chunk(self, slot: _Slot) -> None:
@@ -231,6 +301,11 @@ class ContinuousBatchingScheduler:
         tok, _ = eng.prefill_chunk_step(slot.prompt[start:end], start,
                                         slot.row, slot.temperature,
                                         slot.rid)
+        if eng.draft is not None:
+            # same chunk through the draft pool (sample discarded): the
+            # two caches advance through the prompt in lockstep
+            eng.draft.prefill_chunk_step(slot.prompt[start:end], start,
+                                         slot.draft_row, 0.0, slot.rid)
         slot.filled = end
         self.stats["prefill_chunks"] += 1
         self._register_prefix(slot)
@@ -241,8 +316,82 @@ class ContinuousBatchingScheduler:
             slot.t_last = now
             self.stats["tokens_generated"] += 1
 
+    def _spec_step(self, slots: list, active_idx: list, done: dict
+                   ) -> None:
+        """One speculation tick for every decoding slot (ISSUE 18).
+
+        The cache invariant both pools share at tick entry: positions
+        ``0 .. C-1`` are filled (C = ``slot.length``) and the pending
+        token ``g = generated[-1]`` belongs at position C.  Draft step
+        j feeds token ``y_{j-1}`` at offset ``C+j-1`` (``y_0 = g``),
+        writing its KV and proposing ``d_j``; after k steps the draft
+        pool holds ``0 .. C+k-1``.  The fused verify scores
+        ``[g, d_1..d_k]`` at offset C, writes the target KV for
+        ``C .. C+k``, and returns the accepted prefix length (capped at
+        k-1) plus the bonus — committing ``acc+1`` tokens leaves BOTH
+        pools filled exactly to the new C (the cap's whole point); the
+        rejected tail is garbage at positions >= C' that the next
+        burst's writes replace before the causal mask can read them."""
+        eng = self.engine
+        dra = eng.draft
+        k = eng.spec_tokens
+        b = eng.max_batch
+        tokens = np.zeros(b, np.int32)
+        lengths = np.zeros(b, np.int32)
+        table = np.zeros((b, eng.pages_per_seq), np.int32)
+        d_table = np.zeros((b, eng.pages_per_seq), np.int32)
+        temps = np.zeros(b, np.float32)     # greedy: spec is temp-0 only
+        rids = np.zeros(b, np.int32)
+        active = np.zeros(b, bool)
+        for i in active_idx:
+            s = slots[i]
+            tokens[i] = s.generated[-1]
+            lengths[i] = s.length
+            table[i] = s.row
+            d_table[i] = s.draft_row
+            rids[i] = s.rid
+            active[i] = True
+        burst = np.empty((b, k + 1), np.int32)
+        burst[:, 0] = tokens
+        y = tokens
+        for j in range(k):
+            y, _ = dra.decode(y, lengths + j, d_table, temps, rids,
+                              active)
+            burst[:, j + 1] = y
+        emitted, acc = eng.verify(burst, lengths, table, active)
+        self.stats["decode_steps"] += 1     # one target dispatch per tick
+        self.stats["verify_steps"] += 1
+        self.stats["draft_steps"] += k
+        t_now = time.perf_counter()
+        for i in active_idx:
+            s = slots[i]
+            e = int(acc[i]) + 1
+            self.stats["spec_drafted"] += k
+            self.stats["spec_accepted"] += int(acc[i])
+            # commit one token at a time so an eos / budget stop
+            # truncates the burst exactly where the twin would have
+            # stopped; the tick's latency gap splits evenly across it
+            gap = (t_now - s.t_last) / e
+            reason = None
+            for tok in emitted[i, :e]:
+                s.generated.append(int(tok))
+                s.decode_lat.append(gap)
+                self.stats["tokens_generated"] += 1
+                self.stats["spec_emitted"] += 1
+                reason = self._stop_reason(s)
+                if reason:
+                    break
+            s.t_last = t_now
+            if reason:
+                done[s.rid] = self._finish(s, reason)
+                slots[i] = None
+            else:
+                s.length += e
+
     def _finish(self, slot: _Slot, reason: str) -> Completion:
         self.engine.allocator.free(slot.pages)
+        if slot.draft_pages is not None:
+            self.engine.draft.allocator.free(slot.draft_pages)
         self.stats["evicted"] += 1
         return Completion(rid=slot.rid, prompt_len=slot.plen,
                           tokens=slot.generated, reason=reason,
@@ -319,6 +468,10 @@ class ContinuousBatchingScheduler:
                     # the blocker with nothing active — the pool is empty)
                     time.sleep(max(0.0, min(
                         0.001, queue[0].arrival_s - now)))
+                continue
+            if eng.draft is not None:
+                self._spec_step(slots, active_idx, done)
+                self._occupancy.append(eng.allocator.in_use)
                 continue
             b = eng.max_batch
             tokens = np.zeros(b, np.int32)
@@ -402,6 +555,27 @@ class ContinuousBatchingScheduler:
             "page_reuse_ratio": (round(hit_pages / prompt_pages, 4)
                                  if prompt_pages else 0.0),
             "prefill_tokens_saved": self.stats["prefill_tokens_saved"],
+            # speculative decoding (ISSUE 18): zero-filled on
+            # non-speculative runs, the sync_ms convention — consumers
+            # always see the same keys.  acceptance_rate counts COMMITTED
+            # draft tokens over drafted ones (the bonus is a target
+            # token); target_steps_per_token is the headline — verify
+            # ticks a sequence sat through per token it emitted
+            # (spec_drafted / k sums active slots over ticks, so the
+            # ratio is batch-width independent): 1.0 means speculation
+            # bought nothing over plain decode, 1/k is the floor
+            "spec": {
+                "acceptance_rate": (
+                    round(self.stats["spec_accepted"]
+                          / self.stats["spec_drafted"], 4)
+                    if self.stats["spec_drafted"] else 0.0),
+                "draft_steps": self.stats["draft_steps"],
+                "verify_steps": self.stats["verify_steps"],
+                "target_steps_per_token": (
+                    round(self.stats["spec_drafted"] / eng.spec_tokens
+                          / self.stats["spec_emitted"], 4)
+                    if self.stats["spec_emitted"] else 0.0),
+            },
             # byte-exact page accounting: in_use sampled after every
             # admission/step x the per-page pin across both pools
             "pages": {"page_size": eng.page_size,
@@ -412,7 +586,16 @@ class ContinuousBatchingScheduler:
                       "peak_bytes": max(occ) * page_bytes,
                       "cached_pages": eng.allocator.cached_pages,
                       "cache_evictions": eng.allocator.cache_evictions,
-                      "leaked": eng.allocator.in_use},
+                      "leaked": eng.allocator.in_use,
+                      # the draft pool's occupancy (zero-filled when no
+                      # draft is paired): joint admission means its
+                      # in_use mirrors the target's while running, and
+                      # leaked must end 0 just the same
+                      "draft_peak_in_use": (
+                          eng.draft.allocator.peak_in_use
+                          if eng.draft is not None else 0),
+                      "draft_leaked": (eng.draft.allocator.in_use
+                                       if eng.draft is not None else 0)},
         }
         out["completions"] = [done[r.rid] for r in requests
                               if r.rid in done]

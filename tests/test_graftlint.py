@@ -544,6 +544,45 @@ def prefill_loop(chunk_step, params, kc, vc, chunks):
 """
         assert "R4" not in rules_for(src)
 
+    def test_draft_cache_read_after_verify_dispatch_flagged(self):
+        # ISSUE 18 fixture: the speculative tick runs the draft's
+        # donated decode step k times, then dispatches the target's
+        # fused verify.  The draft pools' CARRY names still point at the
+        # generation the last draft step donated — reading one after the
+        # verify dispatch (e.g. to "snapshot" draft KV for rollback)
+        # touches freed pages.  Rollback is arithmetic on the accepted
+        # length, never a pool read — exactly the contract R4 polices
+        src = """
+import jax
+def spec_tick(draft_step, verify, params, dkc, dvc, kc, vc, burst, y):
+    dprog = jax.jit(draft_step, donate_argnums=(1, 2))
+    for j in range(4):
+        y, dkc2, dvc2 = dprog(params, dkc, dvc, y)
+    vprog = jax.jit(verify, donate_argnums=(1, 2))
+    emitted, acc, kc, vc = vprog(params, kc, vc, burst)
+    snapshot = dkc  # donated draft carry read after verify dispatch
+    return emitted, acc, snapshot
+"""
+        assert "R4" in rules_for(src)
+
+    def test_draft_cache_rebound_to_output_clean(self):
+        # the engine's real shape (scheduler._spec_step via
+        # ServeEngine.decode / .verify): every draft step rebinds the
+        # draft pool names to its outputs in the same statement, and
+        # accept/rollback is computed from `acc` alone — no pool read
+        # ever sees a stale generation
+        src = """
+import jax
+def spec_tick(draft_step, verify, params, dkc, dvc, kc, vc, burst, y):
+    dprog = jax.jit(draft_step, donate_argnums=(1, 2))
+    for j in range(4):
+        y, dkc, dvc = dprog(params, dkc, dvc, y)
+    vprog = jax.jit(verify, donate_argnums=(1, 2))
+    emitted, acc, kc, vc = vprog(params, kc, vc, burst)
+    return emitted, acc, dkc, dvc, kc, vc
+"""
+        assert "R4" not in rules_for(src)
+
     def test_rebound_name_no_longer_shard_map_clean(self):
         src = """
 import jax

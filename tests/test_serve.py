@@ -979,6 +979,370 @@ class TestServeFastPathConfig:
             train_global,
         )
         for kw in (dict(serve_prefix_cache=True),
-                   dict(serve_prefill_chunk=16)):
+                   dict(serve_prefill_chunk=16),
+                   dict(serve_draft_ckpt="/tmp/x", serve_spec_tokens=4)):
             with pytest.raises(ValueError, match="serving fast path"):
                 train_global(Config(**kw))
+
+    def test_spec_flags_required_together(self):
+        with pytest.raises(ValueError, match="TOGETHER"):
+            Config(serve_draft_ckpt="/tmp/x")
+        with pytest.raises(ValueError, match="TOGETHER"):
+            Config(serve_spec_tokens=4)
+        cfg = Config(serve_draft_ckpt="/tmp/x", serve_spec_tokens=4)
+        assert cfg.serve_spec_tokens == 4
+
+    def test_spec_rejects_temperature(self):
+        # eager v1 rejection with the real reason: greedy argmax
+        # acceptance only — the stochastic rejection-sampling rule is
+        # not implemented
+        with pytest.raises(ValueError, match="rejection-sampling"):
+            Config(serve_draft_ckpt="/tmp/x", serve_spec_tokens=4,
+                   serve_temperature=0.8)
+
+    def test_spec_prefix_cache_headroom_counts_spec_tokens(self):
+        # the verify program overshoots k positions past max_new, so the
+        # headroom math must include them: 7 pages pass without spec
+        # (80-token sequences = 5 pages) but 16 spec tokens push a
+        # sequence to 96 tokens = 6 pages == the 6 usable — rejected
+        Config(serve_prefix_cache=True, serve_max_pages=7)
+        with pytest.raises(ValueError, match="serve_spec_tokens"):
+            Config(serve_prefix_cache=True, serve_max_pages=7,
+                   serve_draft_ckpt="/tmp/x", serve_spec_tokens=16)
+
+
+# ----------------------------------------------------------------------
+# ISSUE 18: speculative decoding — draft pool + fused verify
+# ----------------------------------------------------------------------
+
+def _spec_pair(model, tv, draft_model, dv, k, **kw):
+    """(target engine paired with a draft, twin plain engine) sharing
+    one geometry."""
+    draft = _engine(draft_model, dv, **kw)
+    eng = ServeEngine(model, tv["params"], draft=draft, spec_tokens=k,
+                      **{**dict(max_batch=3, page_size=4, max_pages=32,
+                                prompt_buckets=(8, 16), max_seq=24,
+                                seed=0), **kw})
+    return eng
+
+
+class TestSpeculativeAccept:
+    """Device accept math vs a plain-python reference."""
+
+    def _ref(self, logits, draft):
+        b, k = draft.shape
+        tgt = logits.argmax(-1)
+        out_e = np.full((b, k), -1, np.int32)
+        out_a = np.zeros(b, np.int32)
+        for i in range(b):
+            n = 0
+            while n < k and draft[i, n] == tgt[i, n]:
+                n += 1
+            acc = min(n, k - 1)
+            out_a[i] = acc
+            out_e[i, :acc] = draft[i, :acc]
+            out_e[i, acc] = tgt[i, acc]
+        return out_e, out_a
+
+    @pytest.mark.parametrize("k", [1, 2, 4])
+    def test_matches_reference(self, k):
+        rng = np.random.default_rng(5)
+        logits = rng.standard_normal((6, k + 1, 13)).astype(np.float32)
+        draft = rng.integers(0, 13, (6, k)).astype(np.int32)
+        # row 0: force full acceptance to exercise the k-1 cap; row 1:
+        # force total rejection (first draft wrong)
+        full = logits[0].argmax(-1)
+        draft[0] = full[:k]
+        draft[1, 0] = (logits[1, 0].argmax() + 1) % 13
+        emitted, acc = D.speculative_accept(jnp.asarray(logits),
+                                            jnp.asarray(draft))
+        ref_e, ref_a = self._ref(logits, draft)
+        np.testing.assert_array_equal(np.asarray(acc), ref_a)
+        np.testing.assert_array_equal(np.asarray(emitted), ref_e)
+        assert int(acc[0]) == k - 1          # cap engaged
+        assert int(acc[1]) == 0              # burst collapses to bonus
+
+    def test_cap_emits_identical_stream(self):
+        # when every draft matches, the bonus token t_{k-1} IS d_k: the
+        # capped burst d_1..d_{k-1}, t_{k-1} equals d_1..d_k — capping
+        # costs nothing, ever
+        rng = np.random.default_rng(7)
+        logits = rng.standard_normal((2, 5, 11)).astype(np.float32)
+        tgt = logits.argmax(-1)
+        draft = tgt[:, :4].astype(np.int32)
+        emitted, acc = D.speculative_accept(jnp.asarray(logits),
+                                            jnp.asarray(draft))
+        np.testing.assert_array_equal(np.asarray(emitted), draft)
+
+
+class TestSpeculative:
+    # tier-1 keeps the trickiest cell (GQA at the full k=4 burst); the
+    # rest of the 3x2 matrix runs in the slow tier on the 1-core CI
+    # host — gpt k=2 bitwise coverage also rides tier-1 through the
+    # batched-vs-single and zero-retrace tests below
+    @pytest.mark.parametrize("fam,k", [
+        ("llama_gqa", 4),
+        pytest.param("gpt", 2, marks=pytest.mark.slow),
+        pytest.param("llama", 2, marks=pytest.mark.slow),
+        pytest.param("llama_gqa", 2, marks=pytest.mark.slow),
+        pytest.param("gpt", 4, marks=pytest.mark.slow),
+        pytest.param("llama", 4, marks=pytest.mark.slow),
+    ])
+    def test_bitwise_vs_nonspeculative_twin(self, served, fam, k):
+        """THE gate: greedy speculative output is bitwise the twin's —
+        the draft (same family, independently initialized, so real
+        disagreement) only ever changes WHEN tokens appear, never WHICH."""
+        model, v = served(fam)
+        name, mkw = FAMILIES[fam]
+        draft_model = get_model(name, num_classes=VOCAB, scan_layers=True,
+                                **mkw)
+        dv = draft_model.init(jax.random.key(99),
+                              np.asarray(PROMPT, np.int32)[None])
+        reqs = lambda: [Request(rid=i, prompt=PROMPT[:4 + 2 * i],  # noqa: E731
+                                max_new_tokens=6) for i in range(3)]
+        twin = ContinuousBatchingScheduler(
+            _engine(model, v), eos_id=-1).run(reqs())
+        eng = _spec_pair(model, v, draft_model, dv, k)
+        out = ContinuousBatchingScheduler(eng, eos_id=-1).run(reqs())
+        assert ([c.tokens for c in out["completions"]]
+                == [c.tokens for c in twin["completions"]]), (
+            f"{fam} k={k}: speculative stream diverged from the twin")
+        assert out["spec"]["verify_steps"] > 0
+        assert out["spec"]["draft_steps"] == k * out["spec"]["verify_steps"]
+        assert out["pages"]["leaked"] == 0
+        assert out["pages"]["draft_leaked"] == 0
+
+    def test_composes_with_prefix_cache_and_chunked(self, served):
+        """All three fast-path features at once — warm prefix hits +
+        chunked prefill + speculation — still bitwise, in both pools."""
+        model, v = served("gpt")
+        draft_model = get_model("gpt_tiny", num_classes=VOCAB,
+                                scan_layers=True)
+        dv = draft_model.init(jax.random.key(99),
+                              np.asarray(PROMPT, np.int32)[None])
+        kw = dict(max_pages=48, prefix_cache=True, prefill_chunk=4)
+        reqs = lambda: [Request(rid=i, prompt=PROMPT, max_new_tokens=6)  # noqa: E731
+                        for i in range(2)]
+        twin = ContinuousBatchingScheduler(
+            _engine(model, v), eos_id=-1).run(reqs())
+        base = [c.tokens for c in twin["completions"]]
+        eng = _spec_pair(model, v, draft_model, dv, 4, **kw)
+        cold = ContinuousBatchingScheduler(eng, eos_id=-1).run(reqs())
+        warm = ContinuousBatchingScheduler(eng, eos_id=-1).run(reqs())
+        assert [c.tokens for c in cold["completions"]] == base
+        assert [c.tokens for c in warm["completions"]] == base
+        assert warm["page_reuse_ratio"] > 0    # the hits really happened
+        assert warm["prefill_chunks"] > 0
+        assert warm["pages"]["leaked"] == 0
+        assert warm["pages"]["draft_leaked"] == 0
+
+    def test_batched_vs_single_speculative(self, served):
+        """PR 7 gate extended: a slot's ACCEPTED tokens are independent
+        of its batch neighbors (greedy end-to-end, and the verify's
+        per-row masking keeps inactive rows out of every gather)."""
+        model, v = served("gpt")
+        draft_model = get_model("gpt_tiny", num_classes=VOCAB,
+                                scan_layers=True)
+        dv = draft_model.init(jax.random.key(99),
+                              np.asarray(PROMPT, np.int32)[None])
+        reqs = [Request(rid=i, prompt=PROMPT[:3 + i], max_new_tokens=5)
+                for i in range(3)]
+        eng = _spec_pair(model, v, draft_model, dv, 2)
+        batched = ContinuousBatchingScheduler(eng, eos_id=-1).run(reqs)
+        by_rid = {c.rid: c.tokens for c in batched["completions"]}
+        # the same engine pair serves the single-slot runs: engines are
+        # stateless between scheduler runs, and reusing the compiled
+        # programs keeps this in the tier-1 budget on a 1-core host
+        for r in reqs:
+            single = ContinuousBatchingScheduler(
+                eng, eos_id=-1, max_active=1).run(
+                    [Request(rid=r.rid, prompt=r.prompt,
+                             max_new_tokens=5)])
+            assert single["completions"][0].tokens == by_rid[r.rid], (
+                f"rid {r.rid} diverged between batched and single "
+                "speculative decode")
+
+    def test_self_similar_deterministic_acceptance(self, served):
+        """Draft sharing the target's params accepts every proposal:
+        acceptance pins at (k-1)/k (the cap) and target steps per
+        emitted token at ~1/k — the backend-robust bench bar."""
+        model, v = served("gpt")
+        k = 4
+        draft = _engine(model, v, max_seq=32)
+        eng = ServeEngine(model, v["params"], draft=draft, spec_tokens=k,
+                          max_batch=3, page_size=4, max_pages=32,
+                          prompt_buckets=(8, 16), max_seq=32, seed=0)
+        # 17 = 1 prefill token + 16 speculative = exactly 4 full bursts
+        out = ContinuousBatchingScheduler(eng, eos_id=-1).run(
+            [Request(rid=i, prompt=PROMPT, max_new_tokens=17)
+             for i in range(2)])
+        assert out["spec"]["acceptance_rate"] == (k - 1) / k
+        assert out["spec"]["target_steps_per_token"] == 1 / k
+        twin = ContinuousBatchingScheduler(
+            _engine(model, v, max_seq=32), eos_id=-1).run(
+            [Request(rid=i, prompt=PROMPT, max_new_tokens=17)
+             for i in range(2)])
+        assert ([c.tokens for c in out["completions"]]
+                == [c.tokens for c in twin["completions"]])
+
+    def test_eos_truncates_burst_like_twin(self, served):
+        """An eos landing mid-burst must cut the stream exactly where
+        the twin stops — committed one token at a time, the tail of the
+        burst is discarded."""
+        model, v = served("gpt")
+        probe = ContinuousBatchingScheduler(
+            _engine(model, v), eos_id=-1).run(
+                [Request(rid=0, prompt=PROMPT, max_new_tokens=6)])
+        stream = probe["completions"][0].tokens
+        eos = stream[2]     # third token: lands mid-burst at k=4
+        draft = _engine(model, v)
+        eng = ServeEngine(model, v["params"], draft=draft, spec_tokens=4,
+                          max_batch=3, page_size=4, max_pages=32,
+                          prompt_buckets=(8, 16), max_seq=24, seed=0)
+        out = ContinuousBatchingScheduler(eng, eos_id=eos).run(
+            [Request(rid=0, prompt=PROMPT, max_new_tokens=6)])
+        c = out["completions"][0]
+        stop = stream.index(eos)
+        assert c.reason == "eos" and c.tokens == stream[:stop + 1]
+        assert out["pages"]["leaked"] == 0
+        assert out["pages"]["draft_leaked"] == 0
+
+    def test_zero_retraces_speculative(self, served):
+        """Steady state re-dispatches exactly the compiled pair set
+        (draft decode + fused verify on the hot loop, prefill on the
+        admission path) — fresh rids/lengths/pages add ZERO traces."""
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.xla_flags import (
+            compile_event_counts,
+            install_compile_counter,
+        )
+        model, v = served("gpt")
+        draft_model = get_model("gpt_tiny", num_classes=VOCAB,
+                                scan_layers=True)
+        dv = draft_model.init(jax.random.key(99),
+                              np.asarray(PROMPT, np.int32)[None])
+        eng = _spec_pair(model, v, draft_model, dv, 2, max_seq=48,
+                         max_pages=64)
+        assert install_compile_counter()
+        ContinuousBatchingScheduler(eng, eos_id=-1).run(
+            [Request(rid=100, prompt=PROMPT, max_new_tokens=2)])
+        before = compile_event_counts()
+        out = ContinuousBatchingScheduler(eng, eos_id=-1).run(
+            [Request(rid=i, prompt=PROMPT[:4 + i], max_new_tokens=32)
+             for i in range(2)])
+        after = compile_event_counts()
+        assert out["spec"]["verify_steps"] >= 16
+        assert after["traces"] == before["traces"], "speculative retrace"
+        assert after["compiles"] == before["compiles"]
+
+    def test_spec_telemetry_zero_filled_without_draft(self, served):
+        model, v = served("gpt")
+        out = ContinuousBatchingScheduler(_engine(model, v)).run(
+            [Request(rid=0, prompt=PROMPT[:4], max_new_tokens=3)])
+        assert out["spec"] == {"acceptance_rate": 0.0, "draft_steps": 0,
+                               "verify_steps": 0,
+                               "target_steps_per_token": 0.0}
+        assert out["pages"]["draft_peak_in_use"] == 0
+        assert out["pages"]["draft_leaked"] == 0
+
+    def test_pairing_rejections(self, served):
+        model, v = served("gpt")
+        draft_model = get_model("gpt_tiny", num_classes=VOCAB,
+                                scan_layers=True)
+        dv = draft_model.init(jax.random.key(99),
+                              np.asarray(PROMPT, np.int32)[None])
+        # one flag without the other is inert — rejected
+        with pytest.raises(ValueError, match="BOTH"):
+            _engine(model, v, draft=_engine(draft_model, dv))
+        with pytest.raises(ValueError, match="BOTH"):
+            _engine(model, v, spec_tokens=4)
+        # vocab mismatch: ids from different id spaces
+        other = get_model("gpt_tiny", num_classes=VOCAB + 1,
+                          scan_layers=True)
+        ov = other.init(jax.random.key(1),
+                        np.asarray(PROMPT, np.int32)[None])
+        with pytest.raises(ValueError, match="vocabulary mismatch"):
+            _engine(model, v, draft=_engine(other, ov), spec_tokens=2)
+        # MoE draft: densely-evaluated experts cost MORE than the dense
+        # twin at decode — a draft exists to be cheap
+        moe, mv = served("gpt_moe")
+        with pytest.raises(ValueError, match="MoE draft"):
+            _engine(model, v, draft=_engine(moe, mv), spec_tokens=2)
+        # geometry mismatch: the pools must stay position-paired
+        with pytest.raises(ValueError, match="geometry"):
+            _engine(model, v,
+                    draft=_engine(draft_model, dv, page_size=8),
+                    spec_tokens=2)
+        # per-request temperature rejected at submit in spec mode
+        eng = _spec_pair(model, v, draft_model, dv, 2)
+        with pytest.raises(ValueError, match="temperature"):
+            ContinuousBatchingScheduler(eng).run(
+                [Request(rid=0, prompt=PROMPT[:4], max_new_tokens=2,
+                         temperature=0.7)])
+
+
+class TestSpeculativePages:
+    def test_paired_admit_rolls_back_both_pools(self):
+        from learning_deep_neural_network_in_distributed_computing_environment_tpu.serve.cache import (
+            paired_admit,
+        )
+        tgt, dra = PageAllocator(8), PageAllocator(8)
+        # plain success: both pools advance together
+        got = paired_admit(tgt, dra, [], [], 3)
+        assert got is not None and tgt.in_use == dra.in_use == 3
+        # draft pool exhausted -> target's claim + alloc fully unwound
+        dra2 = PageAllocator(4)                  # 3 usable
+        pin = dra2.alloc(2)
+        assert paired_admit(tgt, dra2, [], [], 3) is None
+        assert tgt.in_use == 3                   # back to entry state
+        assert dra2.in_use == 2
+        dra2.free(pin)
+        # target pool exhausted -> nothing touched in the draft pool
+        tgt2 = PageAllocator(4)
+        tgt2.alloc(3)
+        assert paired_admit(tgt2, dra, [], [], 3) is None
+        assert dra.in_use == 3
+        # unequal hit runs break the one-shared-offset contract
+        with pytest.raises(ValueError, match="equal length"):
+            paired_admit(tgt, dra, [1], [], 2)
+
+    def test_dual_pool_joint_occupancy_audit(self, served):
+        """PR 17 shadow-refcount property test extended to the pool
+        PAIR: speculation + prefix cache + chunked prefill over tight
+        twin pools, every allocator operation re-audited in BOTH, and
+        the pools' joint occupancy mirroring through accept/rollback
+        cycles, LRU eviction, backpressure and timeout eviction."""
+        model, v = served("gpt")
+        rng = np.random.default_rng(41)
+        sys_prefix = rng.integers(1, VOCAB, 8).tolist()
+
+        def mk(rid, tail, new=6):
+            return Request(rid=rid,
+                           prompt=sys_prefix + rng.integers(
+                               1, VOCAB, tail).tolist(),
+                           max_new_tokens=new)
+
+        kw = dict(prefix_cache=True, prefill_chunk=4, max_pages=18,
+                  max_seq=28)
+        draft = _engine(model, v, **kw)
+        draft.allocator = _AuditAllocator(18)
+        eng = ServeEngine(model, v["params"], draft=draft, spec_tokens=2,
+                          max_batch=3, page_size=4, prompt_buckets=(8, 16),
+                          seed=0, **kw)
+        eng.allocator = _AuditAllocator(18)
+        out = ContinuousBatchingScheduler(eng).run(
+            [mk(i, 1 + (i % 5)) for i in range(8)])
+        assert out["page_reuse_ratio"] > 0
+        assert out["spec"]["verify_steps"] > 0
+        # the joint invariant: admission is all-or-nothing across the
+        # pair, so the two pools' referenced-page counts track each
+        # other exactly at every quiescent point
+        assert eng.allocator.in_use == draft.allocator.in_use == 0
+        assert eng.allocator.ops > 20 and draft.allocator.ops > 20
+        # timeout eviction releases BOTH pools' spans
+        out2 = ContinuousBatchingScheduler(
+            eng, request_timeout=1e-6).run(
+                [mk(100 + i, 3, new=8) for i in range(4)])
+        assert out2["timed_out"] == 4
+        assert eng.allocator.in_use == draft.allocator.in_use == 0
+        assert out2["pages"]["leaked"] == 0
+        assert out2["pages"]["draft_leaked"] == 0

@@ -243,6 +243,20 @@ for arm in ("cold", "warm"):
     assert pc[arm]["page_accounting_exact"] is True, arm
 for arm in ("monolithic", "chunked"):
     assert cp[arm]["page_accounting_exact"] is True, arm
+# speculative decoding (ISSUE 18): the self-similar draft/target pair
+# must emit the BITWISE baseline streams at k=2 and k=4, accept the
+# capped maximum (k-1)/k of its proposals, and amortize the target to
+# < 0.5 dispatched steps per emitted token at k=4 (the backend-robust
+# bar — CPU wall-clock for two tiny models is noise, the dispatch
+# count is not; measured ~0.27 here)
+sp = out["speculative"]
+assert sp["spec_bitwise"] is True, sp
+assert sp["acceptance_rate"] > 0, sp["acceptance_rate"]
+assert sp["target_steps_per_token"] < 0.5, sp["target_steps_per_token"]
+for arm in ("baseline", "k2", "k4"):
+    assert sp[arm]["page_accounting_exact"] is True, arm
+    assert sp[arm]["pages"]["leaked"] == 0, arm
+    assert sp[arm]["pages"]["draft_leaked"] == 0, arm
 print("serve smoke OK")
 EOF
   src=$?
@@ -819,6 +833,76 @@ rc=$?
 rm -rf "$SERVE_DIR"
 if [ "$rc" -ne 0 ]; then
   echo "serve smoke assertions FAILED (rc=$rc)"
+  exit "$rc"
+fi
+
+# Speculative-decoding smoke (ISSUE 18): train a gpt_tiny DRAFT and a
+# gpt_small TARGET (different arch, different seed — real disagreement),
+# then serve the target through the real CLI twice: plain, and with
+# --serve_draft_ckpt/--serve_spec_tokens 4.  The speculative run must
+# emit byte-identical greedy ids (the draft only changes WHEN tokens
+# appear, never WHICH), accept at least one proposal, stay sanitized
+# (zero post-warmup retraces across draft + verify programs), and leak
+# zero pages from EITHER pool.
+echo "== speculative serve smoke (draft+target ckpts -> CLI, bitwise) =="
+SPEC_DIR=$(mktemp -d)
+JAX_PLATFORMS=cpu python - "$SPEC_DIR" <<'EOF'
+import sys
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.config import Config
+from learning_deep_neural_network_in_distributed_computing_environment_tpu.driver import train_global
+
+d = sys.argv[1]
+kw = dict(dataset="synthetic_lm", epochs_global=1, epochs_local=1,
+          batch_size=8, limit_train_samples=32, limit_eval_samples=16,
+          compute_dtype="float32", augment=False,
+          aggregation_by="weights", checkpoint_every=1)
+train_global(Config(model="gpt_tiny", seed=11,
+                    checkpoint_dir=f"{d}/draft", **kw), progress=False)
+train_global(Config(model="gpt_small", seed=3,
+                    checkpoint_dir=f"{d}/target", **kw), progress=False)
+EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "speculative smoke train phase FAILED (rc=$rc)"; rm -rf "$SPEC_DIR"; exit 1
+fi
+spec_serve() {
+  JAX_PLATFORMS=cpu python -m \
+    learning_deep_neural_network_in_distributed_computing_environment_tpu.main \
+    serve --device cpu --checkpoint_dir "$SPEC_DIR/target" \
+    --serve_prompt 5,9,3,7,2 --serve_max_new_tokens 6 --serve_requests 2 \
+    --serve_max_batch 2 --serve_page_size 8 --serve_max_pages 16 \
+    --serve_prompt_buckets 8 --sanitize "$@" 2>/dev/null
+}
+SPEC_PLAIN=$(spec_serve) || { echo "speculative smoke twin run FAILED"; rm -rf "$SPEC_DIR"; exit 1; }
+SPEC_OUT=$(spec_serve --serve_draft_ckpt "$SPEC_DIR/draft" \
+  --serve_spec_tokens 4) || { echo "speculative smoke spec run FAILED"; rm -rf "$SPEC_DIR"; exit 1; }
+rm -rf "$SPEC_DIR"
+python - <<EOF
+import json
+def parse(out):
+    lines = out.strip().splitlines()
+    toks = [l.rsplit("tokens=", 1)[1] for l in lines if "tokens=" in l]
+    tele = json.loads(next(l for l in lines
+                           if l.startswith("SERVE ")).split(" ", 1)[1])
+    return toks, tele
+plain_toks, plain = parse('''$SPEC_PLAIN''')
+spec_toks, spec = parse('''$SPEC_OUT''')
+assert spec_toks == plain_toks, (spec_toks, plain_toks)
+assert spec["sanitized"] is True
+assert spec["retrace_count"] == 0 and spec["recompile_count"] == 0
+assert spec["spec"]["verify_steps"] > 0, spec["spec"]
+assert spec["spec"]["acceptance_rate"] > 0, spec["spec"]
+assert spec["pages"]["leaked"] == 0
+assert spec["pages"]["draft_leaked"] == 0
+assert plain["spec"] == {"acceptance_rate": 0.0, "draft_steps": 0,
+                         "verify_steps": 0,
+                         "target_steps_per_token": 0.0}, plain["spec"]
+print("speculative smoke OK: CLI spec ids == twin, acceptance",
+      spec["spec"]["acceptance_rate"], "with 0 post-warmup retraces")
+EOF
+rc=$?
+if [ "$rc" -ne 0 ]; then
+  echo "speculative smoke assertions FAILED (rc=$rc)"
   exit "$rc"
 fi
 
